@@ -1,0 +1,34 @@
+"""flink_ml_tpu — a TPU-native ML pipeline framework.
+
+From-scratch rebuild of the capabilities of Apache Flink ML
+(weibozhao/flink-ml, mounted read-only at /root/reference) on JAX/XLA:
+Estimator/Transformer/Model/Pipeline/Graph API, typed JSON-persistable
+params, bounded + unbounded (online) iterative training as XLA while-loops
+/ host-driven stepping, ICI-hardware collectives instead of emulated
+network all-reduce, and a JSON-config benchmark harness. See SURVEY.md at
+the repo root for the reference structural analysis this build follows.
+"""
+
+from .api import AlgoOperator, Estimator, Model, Stage, Transformer
+from .pipeline import Pipeline, PipelineModel
+from .table import SparseBatch, StreamTable, Table
+from .linalg import DenseMatrix, DenseVector, SparseVector, Vectors
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AlgoOperator",
+    "Estimator",
+    "Model",
+    "Stage",
+    "Transformer",
+    "Pipeline",
+    "PipelineModel",
+    "Table",
+    "StreamTable",
+    "SparseBatch",
+    "DenseVector",
+    "SparseVector",
+    "DenseMatrix",
+    "Vectors",
+]

@@ -1,0 +1,66 @@
+"""Shared machinery for linear-model estimators (LogisticRegression,
+LinearSVC, LinearRegression): train-data extraction, SGD wiring, and the
+broadcast-model batched predict path.
+
+Reference pattern: each linear estimator maps rows to LabeledPointWithWeight
+(classification/logisticregression/LogisticRegression.java:70-92), derives
+the init model from the feature dimension (:94-105), runs common SGD
+(:107-114), and its Model broadcasts the coefficient and maps rows
+(LogisticRegressionModel.java:64,131). Here train data is columnar and
+already batched; the model coefficient is a device array applied with one
+matvec per table.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ops.losses import LossFunc
+from ..ops.optimizer import SGD
+from ..table import Table, as_dense_matrix
+
+
+def extract_train_data(
+    table: Table,
+    features_col: str,
+    label_col: Optional[str],
+    weight_col: Optional[str],
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+    X = as_dense_matrix(table.column(features_col))
+    y = None
+    if label_col is not None:
+        y = np.asarray(table.column(label_col), dtype=np.float64)
+    w = None
+    if weight_col is not None:
+        w = np.asarray(table.column(weight_col), dtype=np.float64)
+    return X, y, w
+
+
+def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]):
+    """Wire a Has*-param stage into the SGD optimizer; returns
+    (coefficient, final_loss, num_epochs)."""
+    X, y, w = extract_train_data(
+        table, params.get_features_col(), params.get_label_col(), weight_col
+    )
+    optimizer = SGD(
+        max_iter=params.get_max_iter(),
+        learning_rate=params.get_learning_rate(),
+        global_batch_size=params.get_global_batch_size(),
+        tol=params.get_tol(),
+        reg=params.get_reg(),
+        elastic_net=params.get_elastic_net(),
+    )
+    init_coeff = np.zeros(X.shape[1], dtype=np.float64)
+    return optimizer.optimize(init_coeff, X, y, w, loss_func)
+
+
+def validate_binomial_labels(y: np.ndarray) -> None:
+    """The reference only supports {0, 1} labels for binary linear
+    classifiers (LogisticRegression.java:78-87)."""
+    if not np.all((y == 0.0) | (y == 1.0)):
+        raise ValueError(
+            "Multinomial classification is not supported yet. "
+            "Supported options: [auto, binomial]."
+        )

@@ -1,0 +1,173 @@
+"""KMeans — Lloyd's algorithm over the device mesh.
+
+TPU-native re-design of clustering/kmeans/KMeans.java:87-310,
+KMeansModel.java and KMeansModelData.java:53-116. The reference's per-epoch
+flow (broadcast centroids -> per-point argmin assignment -> partial sums ->
+countWindowAll(parallelism) funnel reduce -> parallelism-1 centroid update,
+KMeans.java:135-212) becomes one jitted while-loop epoch: a pairwise
+distance matmul, a one-hot segment-sum (both MXU work), and a psum over the
+mesh data axis — no funnel-to-one-task bottleneck. Termination is maxIter
+(TerminateOnMaxIter.java:56). Init mirrors selectRandomCentroids
+(KMeans.java:310): sample k distinct rows with the stage seed.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...api import Estimator, Model
+from ...common.param import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasMaxIter,
+    HasPredictionCol,
+    HasSeed,
+)
+from ...ops.distance import DistanceMeasure
+from ...param import IntParam, ParamValidators, StringParam
+from ...parallel import mesh as mesh_lib
+from ...parallel.iteration import iterate_bounded
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class KMeansModelParams(HasDistanceMeasure, HasFeaturesCol, HasPredictionCol):
+    K = IntParam("k", "The max number of clusters to create.", 2, ParamValidators.gt(1))
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
+
+
+class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
+    INIT_MODE = StringParam(
+        "initMode",
+        "The initialization algorithm. Supported options: 'random'.",
+        "random",
+        ParamValidators.in_array(["random"]),
+    )
+
+    def get_init_mode(self) -> str:
+        return self.get(self.INIT_MODE)
+
+    def set_init_mode(self, value: str):
+        return self.set(self.INIT_MODE, value)
+
+
+def _make_epoch_body(measure: DistanceMeasure, X, weights):
+    """One Lloyd iteration. X is (n, d) sharded over the data axis; the
+    segment-sum contraction over n makes XLA reduce over ICI."""
+
+    def body(centroids, _epoch):
+        dists = measure.pairwise(X, centroids)  # (n, k)
+        assign = jnp.argmin(dists, axis=1)  # (n,)
+        one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype)  # (n, k)
+        one_hot = one_hot * weights[:, None]
+        counts = jnp.sum(one_hot, axis=0)  # (k,)
+        sums = one_hot.T @ X  # (k, d) — MXU matmul doubling as segment-sum
+        new_centroids = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-300), centroids
+        )
+        return new_centroids, (new_centroids, counts)
+
+    return body
+
+
+class KMeansModel(Model, KMeansModelParams):
+    def __init__(self):
+        self.centroids: np.ndarray = None  # (k, d)
+        self.weights: np.ndarray = None  # (k,)
+
+    def set_model_data(self, *inputs: Table) -> "KMeansModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.centroids = np.stack(
+            [np.asarray(c.to_array() if hasattr(c, "to_array") else c, dtype=np.float64)
+             for c in row["centroids"]]
+        )
+        w = row["weights"]
+        self.weights = np.asarray(w.to_array() if hasattr(w, "to_array") else w, dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [
+            Table(
+                {
+                    "centroids": [[DenseVector(c) for c in self.centroids]],
+                    "weights": [DenseVector(self.weights)],
+                }
+            )
+        ]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        assign = jax.jit(measure.find_closest)(
+            jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
+        )
+        return [
+            table.with_column(
+                self.get_prediction_col(), np.asarray(assign, dtype=np.int32)
+            )
+        ]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, centroids=self.centroids, weights=self.weights)
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.centroids, self.weights = arrays["centroids"], arrays["weights"]
+
+
+class KMeans(Estimator, KMeansParams):
+    def fit(self, *inputs: Table) -> KMeansModel:
+        (table,) = inputs
+        mesh = mesh_lib.default_mesh()
+        X_host = np.asarray(
+            as_dense_matrix(table.column(self.get_features_col())), dtype=np.float32
+        )
+        n, d = X_host.shape
+        k = self.get_k()
+        if n < k:
+            raise ValueError(f"Number of points ({n}) is less than k ({k})")
+
+        # selectRandomCentroids (KMeans.java:310): sample k rows without replacement.
+        rng = np.random.RandomState(self.get_seed() % (2**32))
+        centroid_idx = rng.choice(n, size=k, replace=False)
+        init_centroids = jnp.asarray(X_host[centroid_idx])
+
+        # Shard points over the data axis, weight-0 padding rows.
+        X_pad, _ = mesh_lib.pad_to_multiple(X_host, mesh_lib.num_data_shards(mesh))
+        w = np.zeros(X_pad.shape[0], dtype=np.float32)
+        w[:n] = 1.0
+        X_dev = jax.device_put(X_pad, NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None)))
+        w_dev = jax.device_put(w, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        epoch = _make_epoch_body(measure, X_dev, w_dev)
+
+        def body(carry, e):
+            centroids, _counts = carry
+            new_centroids, (_, counts) = epoch(centroids, e)
+            return (new_centroids, counts), jnp.asarray(0.0, jnp.float32)
+
+        init_carry = (init_centroids, jnp.zeros((k,), jnp.float32))
+        result = iterate_bounded(body, init_carry, self.get_max_iter())
+        centroids, counts = result.carry
+
+        model = KMeansModel()
+        model.centroids = np.asarray(centroids, dtype=np.float64)
+        model.weights = np.asarray(counts, dtype=np.float64)
+        update_existing_params(model, self)
+        return model

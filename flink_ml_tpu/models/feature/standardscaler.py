@@ -1,0 +1,103 @@
+"""StandardScaler — standardize features by mean removal / std scaling.
+
+TPU-native re-design of feature/standardscaler/StandardScaler.java (mean
+and sample std via a distributed `aggregate` of [sum, squaredSum, count];
+:121-137) and StandardScalerModel.java:85-131. Here the aggregation is a
+jitted column reduction; std uses the same (n-1) sample formula; model
+data always stores both mean and std, and withMean/withStd select what is
+applied at transform time, as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import BooleanParam
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class StandardScalerParams(HasInputCol, HasOutputCol):
+    WITH_MEAN = BooleanParam(
+        "withMean", "Whether centers the data with mean before scaling.", False
+    )
+    WITH_STD = BooleanParam(
+        "withStd", "Whether scales the data with standard deviation.", True
+    )
+
+    def get_with_mean(self) -> bool:
+        return self.get(self.WITH_MEAN)
+
+    def set_with_mean(self, value: bool):
+        return self.set(self.WITH_MEAN, value)
+
+    def get_with_std(self) -> bool:
+        return self.get(self.WITH_STD)
+
+    def set_with_std(self, value: bool):
+        return self.set(self.WITH_STD, value)
+
+
+@jax.jit
+def _fit_stats(X):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    sq_sum = jnp.sum(X * X, axis=0)
+    # sample std with Bessel correction (StandardScaler.java:121-131)
+    var = (sq_sum - n * mean * mean) / jnp.maximum(n - 1, 1)
+    return mean, jnp.sqrt(jnp.maximum(var, 0.0))
+
+
+class StandardScalerModel(Model, StandardScalerParams):
+    def __init__(self):
+        self.mean: np.ndarray = None
+        self.std: np.ndarray = None
+
+    def set_model_data(self, *inputs: Table) -> "StandardScalerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.mean = np.asarray(row["mean"].to_array(), dtype=np.float64)
+        self.std = np.asarray(row["std"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [Table({"mean": [DenseVector(self.mean)], "std": [DenseVector(self.std)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = np.asarray(as_dense_matrix(table.column(self.get_input_col())), dtype=np.float64)
+        out = X
+        if self.get_with_mean():
+            out = out - self.mean
+        if self.get_with_std():
+            scale = np.where(self.std > 0, self.std, 1.0)
+            out = out / scale
+        return [table.with_column(self.get_output_col(), out)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, mean=self.mean, std=self.std)
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.mean, self.std = arrays["mean"], arrays["std"]
+
+
+class StandardScaler(Estimator, StandardScalerParams):
+    def fit(self, *inputs: Table) -> StandardScalerModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        mean, std = _fit_stats(jnp.asarray(X))
+        model = StandardScalerModel()
+        model.mean = np.asarray(mean, dtype=np.float64)
+        model.std = np.asarray(std, dtype=np.float64)
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,73 @@
+"""Batched loss functions for linear-model training.
+
+The reference computes per-sample loss/gradient with scalar BLAS calls
+(common/lossfunc/BinaryLogisticLoss.java, HingeLoss.java,
+LeastSquareLoss.java, LossFunc.java). Here each loss is a *batched* pure
+function over (X[B,d], y[B], w[B], coeff[d]) returning
+(loss_sum, grad_sum[d], weight_sum): the per-sample dot products become one
+X @ coeff matvec and the gradient accumulation one X.T @ multiplier matvec
+— both MXU matmuls. Formulas match the reference exactly (labels in {0,1},
+scaled to ±1 internally) so training losses are comparable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+LossOut = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # (loss_sum, grad_sum, weight_sum)
+
+
+class LossFunc(NamedTuple):
+    """A batched loss: name + callable(X, y, w, coeff) -> (loss_sum, grad_sum, weight_sum)."""
+
+    name: str
+    fn: Callable[..., LossOut]
+
+    def __call__(self, X, y, w, coeff) -> LossOut:
+        return self.fn(X, y, w, coeff)
+
+
+def _binary_logistic(X, y, w, coeff) -> LossOut:
+    dot = X @ coeff
+    label_scaled = 2.0 * y - 1.0
+    margin = dot * label_scaled
+    # log(1 + exp(-margin)) computed stably
+    loss = jnp.sum(w * jnp.logaddexp(0.0, -margin))
+    multiplier = w * (-label_scaled / (jnp.exp(margin) + 1.0))
+    grad = X.T @ multiplier
+    return loss, grad, jnp.sum(w)
+
+
+def _hinge(X, y, w, coeff) -> LossOut:
+    dot = X @ coeff
+    label_scaled = 2.0 * y - 1.0
+    margin = 1.0 - label_scaled * dot
+    loss = jnp.sum(w * jnp.maximum(0.0, margin))
+    multiplier = jnp.where(margin > 0.0, -label_scaled * w, 0.0)
+    grad = X.T @ multiplier
+    return loss, grad, jnp.sum(w)
+
+
+def _least_square(X, y, w, coeff) -> LossOut:
+    dot = X @ coeff
+    diff = dot - y
+    loss = jnp.sum(w * 0.5 * diff * diff)
+    grad = X.T @ (w * diff)
+    return loss, grad, jnp.sum(w)
+
+
+BINARY_LOGISTIC_LOSS = LossFunc("binary_logistic", _binary_logistic)
+HINGE_LOSS = LossFunc("hinge", _hinge)
+LEAST_SQUARE_LOSS = LossFunc("least_square", _least_square)
+
+
+def predict_raw(X, coeff):
+    """Raw linear prediction X @ coeff — the inference hot loop
+    (LogisticRegressionModel.java:131 PredictLabelFunction)."""
+    return X @ coeff
+
+
+def sigmoid(z):
+    return 1.0 / (1.0 + jnp.exp(-z))

@@ -1,0 +1,131 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference scales by Flink task parallelism with netty shuffles between
+subtasks (SURVEY.md §2.3 parallelism table). The TPU-native analogue is a
+`jax.sharding.Mesh` over the chip topology: the `data` axis carries data
+parallelism (the reference's rebalance()+allReduceSum), the optional
+`model` axis feature-shards wide linear models (the TP analogue for sparse
+high-dim LR). Collectives ride ICI; multi-host extends the same mesh over
+DCN via `jax.distributed.initialize` (see `init_distributed`).
+"""
+
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_default_mesh: Optional[Mesh] = None
+
+
+def create_mesh(
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    If `shape` is omitted, all devices go on the first axis and the rest get
+    size 1. Uses jax's device order, which follows the ICI topology on TPU
+    so neighbouring mesh coordinates are ICI neighbours.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    if math.prod(shape) != len(devices):
+        raise ValueError(f"Mesh shape {shape} does not match {len(devices)} devices")
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def default_mesh() -> Mesh:
+    """The process-wide default mesh: all devices on the `data` axis."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = create_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+@contextmanager
+def use_mesh(mesh: Mesh):
+    global _default_mesh
+    prev = _default_mesh
+    _default_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _default_mesh = prev
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    return int(mesh.shape.get(DATA_AXIS, 1))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard leading (batch) dim over the data axis, replicate the rest —
+    the layout of training examples."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def model_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the trailing (feature) dim over the model axis — the layout of
+    feature-sharded wide model vectors."""
+    if MODEL_AXIS not in mesh.axis_names:
+        return replicated_sharding(mesh)
+    return NamedSharding(mesh, P(*([None] * (ndim - 1)), MODEL_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — the analogue of the reference's broadcast variables
+    (BroadcastUtils.withBroadcastStream, BroadcastUtils.java:64)."""
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(array, multiple: int, axis: int = 0, pad_value=0):
+    """Pad `axis` up to a multiple so it divides evenly across shards.
+
+    TPUs need static, evenly divisible shapes; the reference instead lets
+    Flink deal ragged partitions. Returns (padded, original_length).
+    """
+    n = array.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return array, n
+    pad_width = [(0, 0)] * array.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(np.asarray(array), pad_width, constant_values=pad_value), n
+
+
+def shard_batch(mesh: Mesh, array, pad_value=0) -> Tuple[jax.Array, int]:
+    """Device-put a host array sharded over the data axis (padding as needed).
+
+    Returns (device_array, original_row_count). The padding rows must be
+    masked out by the caller (weight 0 in training math).
+    """
+    shards = num_data_shards(mesh)
+    padded, n = pad_to_multiple(np.asarray(array), shards, axis=0, pad_value=pad_value)
+    return jax.device_put(padded, data_sharding(mesh, padded.ndim)), n
+
+
+def replicate(mesh: Mesh, array) -> jax.Array:
+    return jax.device_put(np.asarray(array), replicated_sharding(mesh))
+
+
+def init_distributed(coordinator_address: Optional[str] = None, **kwargs) -> None:
+    """Multi-host bring-up over DCN (the analogue of the reference's cluster
+    deployment). No-op when running single-process."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address, **kwargs)

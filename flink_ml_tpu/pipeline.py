@@ -1,0 +1,115 @@
+"""Pipeline / PipelineModel — sequential stage composition.
+
+Mirrors flink-ml-core/.../builder/Pipeline.java:79-107 and
+PipelineModel.java:63-68: `Pipeline.fit` trains each Estimator on the data
+as transformed by all earlier stages, producing a `PipelineModel` of the
+trained models; `PipelineModel.transform` folds inputs through every stage.
+Execution here is eager (each stage consumes materialized columnar tables);
+there is no lazy client graph because there is no remote cluster to submit
+to — XLA compilation inside each stage is the deferred-execution layer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence
+
+from .api import AlgoOperator, Estimator, Model, Stage
+from .table import Table
+from .utils import read_write
+
+
+def _transform_one(stage: Stage, table: Table) -> Table:
+    outputs = stage.transform(table)  # type: ignore[attr-defined]
+    if len(outputs) != 1:
+        raise ValueError(f"Stage {type(stage).__name__} must produce exactly 1 output table")
+    return outputs[0]
+
+
+class PipelineModel(Model):
+    """Model produced by Pipeline.fit (builder/PipelineModel.java)."""
+
+    def __init__(self, stages: Sequence[Stage] = ()):
+        self._stages: List[Stage] = list(stages)
+
+    @property
+    def stages(self) -> List[Stage]:
+        return self._stages
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        if len(inputs) != 1:
+            raise ValueError("PipelineModel.transform expects exactly 1 input table")
+        table = inputs[0]
+        for stage in self._stages:
+            table = _transform_one(stage, table)
+        return [table]
+
+    def save(self, path: str) -> None:
+        read_write.save_metadata(self, path, {"numStages": len(self._stages)})
+        for i, stage in enumerate(self._stages):
+            stage.save(read_write.get_path_for_pipeline_stage(i, len(self._stages), path))
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineModel":
+        metadata = read_write.load_metadata(path)
+        num_stages = int(metadata.get("numStages", metadata.get("num_stages", 0)))
+        stages = [
+            read_write.load_stage(
+                read_write.get_path_for_pipeline_stage(i, num_stages, path)
+            )
+            for i in range(num_stages)
+        ]
+        return cls(stages)
+
+
+class Pipeline(Estimator):
+    """Sequential Estimator (builder/Pipeline.java:79-107)."""
+
+    def __init__(self, stages: Sequence[Stage] = ()):
+        self._stages: List[Stage] = list(stages)
+
+    @property
+    def stages(self) -> List[Stage]:
+        return self._stages
+
+    def fit(self, *inputs: Table) -> PipelineModel:
+        if len(inputs) != 1:
+            raise ValueError("Pipeline.fit expects exactly 1 input table")
+        table = inputs[0]
+
+        last_estimator_idx = -1
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                last_estimator_idx = i
+
+        model_stages: List[Stage] = []
+        for i, stage in enumerate(self._stages):
+            if isinstance(stage, Estimator):
+                model: Stage = stage.fit(table)
+            else:
+                model = stage
+            model_stages.append(model)
+            if i < last_estimator_idx:
+                if not isinstance(model, AlgoOperator):
+                    raise TypeError(
+                        f"Intermediate stage {type(stage).__name__} cannot transform data"
+                    )
+                table = _transform_one(model, table)
+        return PipelineModel(model_stages)
+
+    def save(self, path: str) -> None:
+        read_write.save_metadata(self, path, {"numStages": len(self._stages)})
+        for i, stage in enumerate(self._stages):
+            stage.save(read_write.get_path_for_pipeline_stage(i, len(self._stages), path))
+
+    @classmethod
+    def load(cls, path: str) -> "Pipeline":
+        metadata = read_write.load_metadata(path)
+        num_stages = int(metadata.get("numStages", metadata.get("num_stages", 0)))
+        stages = [
+            read_write.load_stage(
+                read_write.get_path_for_pipeline_stage(i, num_stages, path)
+            )
+            for i in range(num_stages)
+        ]
+        return cls(stages)

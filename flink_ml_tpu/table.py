@@ -1,0 +1,283 @@
+"""Columnar Table abstraction — the data plane of the framework.
+
+The reference passes Flink `Table`s (row streams) between stages
+(flink-ml-core/.../api/AlgoOperator.java:31). A row stream is the wrong
+layout for a TPU: the MXU wants large batched arrays. So the TPU-native
+Table is a dict of named *columns*; numeric columns are (n,) or (n, d)
+arrays that can live on device and be sharded over a mesh, string/object
+columns stay host-side numpy object arrays. Bounded tables are fully
+materialized; unbounded (online) data is a `StreamTable` — an iterator of
+bounded mini-batch Tables (the analogue of the reference's unbounded
+DataStream + countWindowAll global batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .linalg import DenseVector, SparseVector, Vector
+
+__all__ = ["Table", "StreamTable", "SparseBatch", "as_dense_matrix", "as_sparse_batch"]
+
+
+class SparseBatch:
+    """Padded-CSR batch of sparse vectors: TPU-friendly static shapes.
+
+    `indices`: (n, k) int32, padded entries = -1; `values`: (n, k) float.
+    Replaces per-row SparseVector objects in batched compute — gathers and
+    segment-sums over this layout map onto the VPU without dynamic shapes.
+    """
+
+    __slots__ = ("size", "indices", "values")
+
+    def __init__(self, size: int, indices, values):
+        self.size = int(size)
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.values = np.asarray(values, dtype=np.float64)
+        if self.indices.shape != self.values.shape or self.indices.ndim != 2:
+            raise ValueError("SparseBatch requires matching (n, k) indices/values")
+
+    @property
+    def n(self) -> int:
+        return int(self.indices.shape[0])
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.n, self.size), dtype=np.float64)
+        rows, cols = np.nonzero(self.indices >= 0)
+        out[rows, self.indices[rows, cols]] = self.values[rows, cols]
+        return out
+
+    def row(self, i: int) -> SparseVector:
+        mask = self.indices[i] >= 0
+        return SparseVector(self.size, self.indices[i][mask], self.values[i][mask])
+
+    def __len__(self):
+        return self.n
+
+
+def _normalize_column(values: Any):
+    """Normalize a user-provided column into an internal representation."""
+    if isinstance(values, (np.ndarray, SparseBatch)):
+        return values
+    try:
+        import jax
+
+        if isinstance(values, jax.Array):
+            return values
+    except ImportError:  # pragma: no cover
+        pass
+    values = list(values)
+    if values and isinstance(values[0], Vector):
+        if all(isinstance(v, DenseVector) for v in values):
+            sizes = {v.size() for v in values}
+            if len(sizes) == 1:
+                return np.stack([v.values for v in values])
+        if all(isinstance(v, SparseVector) for v in values):
+            return _sparse_vectors_to_batch(values)
+        return _object_array(values)
+    try:
+        arr = np.asarray(values)
+    except (ValueError, TypeError):
+        return _object_array(values)
+    if arr.dtype == object or arr.dtype.kind in "US" or arr.shape[:1] != (len(values),):
+        return _object_array(values)
+    return arr
+
+
+def _object_array(values: Sequence) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def _sparse_vectors_to_batch(vectors: Sequence[SparseVector]) -> SparseBatch:
+    size = max((v.size() for v in vectors), default=0)
+    k = max((v.indices.size for v in vectors), default=1) or 1
+    n = len(vectors)
+    indices = np.full((n, k), -1, dtype=np.int32)
+    values = np.zeros((n, k), dtype=np.float64)
+    for i, v in enumerate(vectors):
+        nnz = v.indices.size
+        indices[i, :nnz] = v.indices
+        values[i, :nnz] = v.values
+    return SparseBatch(size, indices, values)
+
+
+class Table:
+    """A bounded, named-column table."""
+
+    def __init__(self, data: Dict[str, Any]):
+        self._columns: Dict[str, Any] = {}
+        n = None
+        for name, values in data.items():
+            col = _normalize_column(values)
+            rows = len(col) if isinstance(col, SparseBatch) else int(np.shape(col)[0])
+            if n is None:
+                n = rows
+            elif rows != n:
+                raise ValueError(
+                    f"Column {name} has {rows} rows, expected {n}"
+                )
+            self._columns[name] = col
+        self._num_rows = n or 0
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "Table":
+        return Table(data)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Sequence], names: Sequence[str]) -> "Table":
+        cols: Dict[str, List] = {name: [] for name in names}
+        for row in rows:
+            for name, value in zip(names, row):
+                cols[name].append(value)
+        return Table(cols)
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def column(self, name: str):
+        if name not in self._columns:
+            raise KeyError(f"Column {name!r} not in table (have {self.column_names})")
+        return self._columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    # -- transformation -----------------------------------------------------
+    def with_column(self, name: str, values) -> "Table":
+        data = dict(self._columns)
+        data[name] = values
+        return Table(data)
+
+    def with_columns(self, updates: Dict[str, Any]) -> "Table":
+        data = dict(self._columns)
+        data.update(updates)
+        return Table(data)
+
+    def select(self, *names: str) -> "Table":
+        return Table({name: self.column(name) for name in names})
+
+    def drop(self, *names: str) -> "Table":
+        return Table({k: v for k, v in self._columns.items() if k not in names})
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(k, k): v for k, v in self._columns.items()})
+
+    def take(self, indices) -> "Table":
+        out = {}
+        for name, col in self._columns.items():
+            if isinstance(col, SparseBatch):
+                out[name] = SparseBatch(col.size, col.indices[indices], col.values[indices])
+            else:
+                out[name] = col[indices]
+        return Table(out)
+
+    def head(self, k: int) -> "Table":
+        return self.take(np.arange(min(k, self._num_rows)))
+
+    def concat(self, other: "Table") -> "Table":
+        out = {}
+        for name in self.column_names:
+            a, b = self._columns[name], other.column(name)
+            if isinstance(a, SparseBatch):
+                if a.size != b.size:
+                    raise ValueError("SparseBatch size mismatch in concat")
+                k = max(a.indices.shape[1], b.indices.shape[1])
+
+                def pad(sb: SparseBatch):
+                    pad_k = k - sb.indices.shape[1]
+                    if pad_k == 0:
+                        return sb.indices, sb.values
+                    return (
+                        np.pad(sb.indices, ((0, 0), (0, pad_k)), constant_values=-1),
+                        np.pad(sb.values, ((0, 0), (0, pad_k))),
+                    )
+
+                ia, va = pad(a)
+                ib, vb = pad(b)
+                out[name] = SparseBatch(
+                    a.size, np.concatenate([ia, ib]), np.concatenate([va, vb])
+                )
+            else:
+                out[name] = np.concatenate([np.asarray(a), np.asarray(b)])
+        return Table(out)
+
+    def rows(self) -> Iterator[Dict[str, Any]]:
+        """Row iterator for host-side consumption (tests, collect())."""
+        for i in range(self._num_rows):
+            row = {}
+            for name, col in self._columns.items():
+                if isinstance(col, SparseBatch):
+                    row[name] = col.row(i)
+                else:
+                    v = col[i]
+                    if isinstance(v, np.ndarray) and v.ndim == 1:
+                        v = DenseVector(v)
+                    row[name] = v
+            yield row
+
+    def collect(self) -> List[Dict[str, Any]]:
+        return list(self.rows())
+
+    def __repr__(self):
+        return f"Table(rows={self._num_rows}, columns={self.column_names})"
+
+
+class StreamTable:
+    """An unbounded table: an iterable of bounded mini-batch Tables.
+
+    The analogue of the reference's unbounded DataStream input for online
+    estimators (OnlineKMeans.java:44-60, OnlineLogisticRegression.java). A
+    StreamTable may only be iterated once unless constructed from a list.
+    """
+
+    def __init__(self, batches: Iterable[Table]):
+        self._batches = batches
+
+    def __iter__(self) -> Iterator[Table]:
+        return iter(self._batches)
+
+    @staticmethod
+    def from_batches(batches: Sequence[Table]) -> "StreamTable":
+        return StreamTable(list(batches))
+
+
+def as_dense_matrix(col) -> np.ndarray:
+    """Coerce a features column to a dense (n, d) float array."""
+    if isinstance(col, SparseBatch):
+        return col.to_dense()
+    arr = col
+    if isinstance(arr, np.ndarray) and arr.dtype == object:
+        from .linalg import vectors_to_dense_batch
+
+        return vectors_to_dense_batch(list(arr))
+    arr = np.asarray(arr, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return arr
+
+
+def as_sparse_batch(col, size: Optional[int] = None) -> SparseBatch:
+    """Coerce a features column to a SparseBatch."""
+    if isinstance(col, SparseBatch):
+        return col
+    if isinstance(col, np.ndarray) and col.dtype == object:
+        return _sparse_vectors_to_batch([v.to_sparse() for v in col])
+    dense = as_dense_matrix(col)
+    n, d = dense.shape
+    indices = np.tile(np.arange(d, dtype=np.int32), (n, 1))
+    return SparseBatch(size or d, indices, dense)

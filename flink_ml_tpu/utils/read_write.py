@@ -1,0 +1,124 @@
+"""Stage persistence: metadata JSON + model-data files.
+
+Keeps the reference's on-disk protocol (flink-ml-core/.../util/
+ReadWriteUtils.java): `{path}/metadata` is a JSON object with `className`,
+`timestamp`, `paramMap` (param name -> json-encoded value) plus extra
+metadata (:98-140); model data lives under `{path}/data` (:440-460);
+pipelines store stages under `stages/{idx}` subdirs (:193-246); loading
+re-instantiates the class named in metadata and dispatches to its `load`
+(:376-410). Java class names from the reference are aliased to our classes
+so metadata written by the reference resolves here too.
+
+Model arrays are stored as `.npz` (the reference's per-type binary encoders
+become numpy's portable container; there is no JVM to share a wire format
+with).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# Reference Java package -> our module area, e.g.
+# org.apache.flink.ml.clustering.kmeans.KMeans -> flink_ml_tpu.models.clustering.kmeans.KMeans
+_JAVA_PREFIX = "org.apache.flink.ml."
+_PY_PREFIX = "flink_ml_tpu.models."
+_PYFLINK_PREFIX = "pyflink.ml.lib."
+_CORE_ALIASES = {
+    "org.apache.flink.ml.builder.Pipeline": "flink_ml_tpu.pipeline.Pipeline",
+    "org.apache.flink.ml.builder.PipelineModel": "flink_ml_tpu.pipeline.PipelineModel",
+    "org.apache.flink.ml.builder.Graph": "flink_ml_tpu.graph.Graph",
+    "org.apache.flink.ml.builder.GraphModel": "flink_ml_tpu.graph.GraphModel",
+    "pyflink.ml.core.builder.Pipeline": "flink_ml_tpu.pipeline.Pipeline",
+    "pyflink.ml.core.builder.PipelineModel": "flink_ml_tpu.pipeline.PipelineModel",
+}
+
+
+def _resolve_class_name(class_name: str):
+    if class_name in _CORE_ALIASES:
+        class_name = _CORE_ALIASES[class_name]
+    elif class_name.startswith(_JAVA_PREFIX):
+        class_name = _PY_PREFIX + class_name[len(_JAVA_PREFIX):].lower().rsplit(".", 1)[
+            0
+        ] + "." + class_name.rsplit(".", 1)[1]
+    elif class_name.startswith(_PYFLINK_PREFIX):
+        class_name = _PY_PREFIX + class_name[len(_PYFLINK_PREFIX):]
+    module_name, _, cls_name = class_name.rpartition(".")
+    module = importlib.import_module(module_name)
+    return getattr(module, cls_name)
+
+
+def save_metadata(stage, path: str, extra_metadata: Optional[Dict[str, Any]] = None) -> None:
+    os.makedirs(path, exist_ok=True)
+    metadata: Dict[str, Any] = dict(extra_metadata or {})
+    metadata["className"] = f"{type(stage).__module__}.{type(stage).__qualname__}"
+    metadata["timestamp"] = int(time.time() * 1000)
+    metadata["paramMap"] = {
+        p.name: p.json_encode(v) for p, v in stage.get_param_map().items()
+    }
+    metadata_file = os.path.join(path, "metadata")
+    if os.path.exists(metadata_file):
+        raise IOError(f"File {metadata_file} already exists")
+    with open(metadata_file, "w") as f:
+        json.dump(metadata, f)
+
+
+def load_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "metadata")) as f:
+        return json.load(f)
+
+
+def instantiate_with_params(metadata: Dict[str, Any]):
+    """Re-instantiate a stage from metadata (ReadWriteUtils.instantiateWithParams:376)."""
+    cls = _resolve_class_name(metadata["className"])
+    stage = cls()
+    for name, json_value in metadata.get("paramMap", {}).items():
+        param = stage.get_param(name)
+        if param is None:
+            continue  # tolerate params from other versions, as the reference does
+        stage.set(param, param.json_decode(json_value))
+    return stage
+
+
+def load_stage(path: str):
+    """Load any stage by dispatching on the class named in its metadata
+    (ReadWriteUtils.loadStage:410)."""
+    metadata = load_metadata(path)
+    cls = _resolve_class_name(metadata["className"])
+    return cls.load(path)
+
+
+def get_data_path(path: str) -> str:
+    return os.path.join(path, "data")
+
+
+def save_model_arrays(path: str, name: str = "model_data", **arrays) -> None:
+    """Persist model arrays under `{path}/data/{name}.npz`
+    (the analogue of ReadWriteUtils.saveModelData:440)."""
+    data_dir = get_data_path(path)
+    os.makedirs(data_dir, exist_ok=True)
+    np.savez(os.path.join(data_dir, name + ".npz"), **{
+        k: np.asarray(v) for k, v in arrays.items()
+    })
+
+
+def load_model_arrays(path: str, name: str = "model_data") -> Dict[str, np.ndarray]:
+    """Restore model arrays saved by `save_model_arrays`
+    (analogue of ReadWriteUtils.loadModelData:460)."""
+    with np.load(os.path.join(get_data_path(path), name + ".npz"), allow_pickle=True) as f:
+        return {k: f[k] for k in f.files}
+
+
+def model_data_exists(path: str, name: str = "model_data") -> bool:
+    return os.path.exists(os.path.join(get_data_path(path), name + ".npz"))
+
+
+def get_path_for_pipeline_stage(index: int, num_stages: int, path: str) -> str:
+    """`stages/{zero-padded idx}` layout (ReadWriteUtils.java:193-246)."""
+    width = max(len(str(num_stages - 1)), 5)
+    return os.path.join(path, "stages", str(index).zfill(width))

@@ -1,0 +1,50 @@
+"""Test harness configuration.
+
+Runs the whole test suite on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) — the analogue of the
+reference's in-JVM MiniCluster test substrate (SURVEY.md §4): collectives,
+sharding, and iteration paths execute multi-device without TPU hardware.
+Must set env vars before jax initializes, hence the top-of-file placement.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
+# at interpreter start, which wins over the env var — override it back.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.create_mesh(("data",))
+    with mesh_lib.use_mesh(m):
+        yield m
+
+
+@pytest.fixture
+def mesh_2d():
+    """4x2 (data, model) mesh for feature-sharded tests."""
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.create_mesh(("data", "model"), shape=(4, 2))
+    with mesh_lib.use_mesh(m):
+        yield m
+
+
+@pytest.fixture(autouse=True)
+def _reset_default_mesh():
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    yield
+    mesh_lib.set_default_mesh(None)

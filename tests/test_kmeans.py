@@ -1,0 +1,99 @@
+"""KMeans battery — mirrors flink-ml-lib KMeansTest.java:34-56: param
+defaults, fit+transform on the canonical tiny dataset, save/load,
+get/set model data."""
+
+import numpy as np
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.clustering.kmeans import KMeans, KMeansModel
+from flink_ml_tpu.table import Table
+
+# KMeansTest.java DATA: two clusters around (0, 0.x) and (9, 0.x)
+DATA = [
+    Vectors.dense(0.0, 0.0),
+    Vectors.dense(0.0, 0.3),
+    Vectors.dense(0.3, 0.0),
+    Vectors.dense(9.0, 0.0),
+    Vectors.dense(9.0, 0.6),
+    Vectors.dense(9.6, 0.0),
+]
+
+
+def _table():
+    return Table({"features": DATA})
+
+
+def test_param_defaults():
+    km = KMeans()
+    assert km.get_k() == 2
+    assert km.get_max_iter() == 20
+    assert km.get_init_mode() == "random"
+    assert km.get_distance_measure() == "euclidean"
+    assert km.get_features_col() == "features"
+    assert km.get_prediction_col() == "prediction"
+
+
+def _groups(table, pred_col="prediction"):
+    out = {}
+    for row in table.collect():
+        out.setdefault(int(row[pred_col]), set()).add(tuple(row["features"].to_array()))
+    return sorted(out.values(), key=lambda s: min(s))
+
+
+def test_fit_and_predict():
+    model = KMeans().set_seed(42).set_max_iter(10).fit(_table())
+    out = model.transform(_table())[0]
+    groups = _groups(out)
+    assert groups == [
+        {(0.0, 0.0), (0.0, 0.3), (0.3, 0.0)},
+        {(9.0, 0.0), (9.0, 0.6), (9.6, 0.0)},
+    ]
+    # centroids converge to cluster means
+    cents = np.sort(model.centroids[:, 0])
+    np.testing.assert_allclose(cents, [0.1, 9.2], atol=1e-5)
+
+
+def test_cosine_distance():
+    data = [
+        Vectors.dense(1.0, 1.0),
+        Vectors.dense(2.0, 2.0),
+        Vectors.dense(1.0, -1.0),
+        Vectors.dense(3.0, -3.0),
+    ]
+    model = (
+        KMeans().set_distance_measure("cosine").set_seed(0).set_max_iter(10)
+    ).fit(Table({"features": data}))
+    out = model.transform(Table({"features": data}))[0]
+    pred = [int(r["prediction"]) for r in out.collect()]
+    assert pred[0] == pred[1] and pred[2] == pred[3] and pred[0] != pred[2]
+
+
+def test_fewer_points_than_clusters():
+    import pytest
+
+    with pytest.raises(ValueError):
+        KMeans().set_k(5).fit(Table({"features": DATA[:3]}))
+
+
+def test_save_load(tmp_path):
+    model = KMeans().set_seed(7).fit(_table())
+    path = str(tmp_path / "km")
+    model.save(path)
+    loaded = KMeansModel.load(path)
+    np.testing.assert_allclose(loaded.centroids, model.centroids)
+    out = loaded.transform(_table())[0]
+    assert _groups(out) == _groups(model.transform(_table())[0])
+
+
+def test_get_set_model_data():
+    model = KMeans().set_seed(7).fit(_table())
+    data = model.get_model_data()[0]
+    other = KMeansModel().set_model_data(data)
+    np.testing.assert_allclose(other.centroids, model.centroids)
+    np.testing.assert_allclose(other.weights, model.weights)
+
+
+def test_distributed_fit(mesh8):
+    model = KMeans().set_seed(42).set_max_iter(10).fit(_table())
+    out = model.transform(_table())[0]
+    assert len(_groups(out)) == 2

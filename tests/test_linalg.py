@@ -1,0 +1,104 @@
+"""linalg value types + BLAS — mirrors BLASTest/DenseVectorTest/
+SparseVectorTest in flink-ml-core."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import (
+    BLAS,
+    DenseMatrix,
+    DenseVector,
+    SparseVector,
+    Vectors,
+    VectorWithNorm,
+)
+
+
+def test_dense_vector_basics():
+    v = Vectors.dense(1.0, 2.0, 3.0)
+    assert v.size() == 3
+    assert v.get(1) == 2.0
+    assert list(v) == [1.0, 2.0, 3.0]
+    v.set(0, 9.0)
+    assert v.get(0) == 9.0
+    assert v.clone() == v and v.clone() is not v
+
+
+def test_sparse_vector_sorts_and_checks():
+    v = SparseVector(5, [3, 1], [30.0, 10.0])
+    assert v.indices.tolist() == [1, 3]
+    assert v.values.tolist() == [10.0, 30.0]
+    assert v.get(3) == 30.0
+    assert v.get(0) == 0.0
+    np.testing.assert_array_equal(v.to_array(), [0, 10.0, 0, 30.0, 0])
+    with pytest.raises(ValueError):
+        SparseVector(2, [0, 5], [1.0, 2.0])
+    with pytest.raises(ValueError):
+        SparseVector(5, [1, 1], [1.0, 2.0])
+
+
+def test_dense_sparse_conversion():
+    d = Vectors.dense(0.0, 1.0, 0.0, 2.0)
+    s = d.to_sparse()
+    assert s.indices.tolist() == [1, 3]
+    assert s.to_dense() == d
+
+
+def test_blas_dot():
+    d1 = Vectors.dense(1.0, 2.0, 3.0)
+    d2 = Vectors.dense(4.0, 5.0, 6.0)
+    s1 = Vectors.sparse(3, [0, 2], [1.0, 3.0])
+    s2 = Vectors.sparse(3, [1, 2], [5.0, 6.0])
+    assert BLAS.dot(d1, d2) == 32.0
+    assert BLAS.dot(s1, d2) == 4.0 + 18.0
+    assert BLAS.dot(d1, s2) == 10.0 + 18.0
+    assert BLAS.dot(s1, s2) == 18.0
+
+
+def test_blas_axpy():
+    y = Vectors.dense(1.0, 1.0, 1.0)
+    BLAS.axpy(2.0, Vectors.dense(1.0, 2.0, 3.0), y)
+    np.testing.assert_array_equal(y.values, [3.0, 5.0, 7.0])
+    y2 = Vectors.dense(0.0, 0.0, 0.0)
+    BLAS.axpy(1.0, Vectors.sparse(3, [1], [4.0]), y2)
+    np.testing.assert_array_equal(y2.values, [0.0, 4.0, 0.0])
+    # k-limited variant (BLAS.java axpy with k)
+    y3 = Vectors.dense(0.0, 0.0, 0.0)
+    BLAS.axpy(1.0, Vectors.dense(1.0, 2.0, 3.0), y3, k=2)
+    np.testing.assert_array_equal(y3.values, [1.0, 2.0, 0.0])
+
+
+def test_blas_norms_scal_hdot():
+    v = Vectors.dense(3.0, -4.0)
+    assert BLAS.norm2(v) == 5.0
+    assert BLAS.asum(v) == 7.0
+    BLAS.scal(2.0, v)
+    np.testing.assert_array_equal(v.values, [6.0, -8.0])
+    y = Vectors.dense(2.0, 3.0, 4.0)
+    BLAS.hdot(Vectors.sparse(3, [0, 2], [10.0, 10.0]), y)
+    np.testing.assert_array_equal(y.values, [20.0, 0.0, 40.0])
+
+
+def test_blas_gemv():
+    m = DenseMatrix(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    y = Vectors.dense(1.0, 1.0)
+    BLAS.gemv(1.0, m, False, Vectors.dense(1.0, 1.0), 0.5, y)
+    np.testing.assert_array_equal(y.values, [3.5, 7.5])
+    y2 = Vectors.dense(0.0, 0.0)
+    BLAS.gemv(1.0, m, True, Vectors.dense(1.0, 0.0), 0.0, y2)
+    np.testing.assert_array_equal(y2.values, [1.0, 2.0])
+
+
+def test_dense_matrix_layouts():
+    m = DenseMatrix(2, 3)
+    assert m.num_rows == 2 and m.num_cols == 3
+    m.set(0, 1, 5.0)
+    assert m.get(0, 1) == 5.0
+    # column-major flat array like the reference serializers
+    m2 = DenseMatrix(2, 2, [1.0, 2.0, 3.0, 4.0])
+    assert m2.get(0, 0) == 1.0 and m2.get(1, 0) == 2.0 and m2.get(0, 1) == 3.0
+
+
+def test_vector_with_norm():
+    vn = VectorWithNorm(Vectors.dense(3.0, 4.0))
+    assert vn.l2_norm == 5.0

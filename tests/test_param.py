@@ -1,0 +1,119 @@
+"""Param system behavior — mirrors the battery of
+flink-ml-core/src/test/java/org/apache/flink/ml/api/StageTest.java."""
+
+import json
+
+import pytest
+
+from flink_ml_tpu.param import (
+    BooleanParam,
+    FloatParam,
+    IntArrayParam,
+    IntParam,
+    ParamValidators,
+    StringArrayParam,
+    StringParam,
+    VectorParam,
+    WithParams,
+)
+from flink_ml_tpu.linalg import Vectors
+
+
+class MyStage(WithParams):
+    ALPHA = FloatParam("alpha", "Alpha value.", 1.0, ParamValidators.gt(0.0))
+    COUNT = IntParam("count", "A count.", 5, ParamValidators.in_range(0, 100))
+    NAME = StringParam("name", "A name.", "default")
+    FLAG = BooleanParam("flag", "A flag.", False)
+    IDS = IntArrayParam("ids", "Some ids.", [1, 2])
+    TAGS = StringArrayParam("tags", "Some tags.", None)
+    VEC = VectorParam("vec", "A vector.", None)
+
+
+def test_defaults():
+    s = MyStage()
+    assert s.get(MyStage.ALPHA) == 1.0
+    assert s.get(MyStage.COUNT) == 5
+    assert s.get(MyStage.NAME) == "default"
+    assert s.get(MyStage.FLAG) is False
+    assert s.get(MyStage.IDS) == [1, 2]
+    assert s.get(MyStage.TAGS) is None
+
+
+def test_set_get():
+    s = MyStage()
+    s.set(MyStage.ALPHA, 2.5).set(MyStage.NAME, "x")
+    assert s.get(MyStage.ALPHA) == 2.5
+    assert s.get(MyStage.NAME) == "x"
+
+
+def test_validator_rejects():
+    s = MyStage()
+    with pytest.raises(ValueError):
+        s.set(MyStage.ALPHA, -1.0)
+    with pytest.raises(ValueError):
+        s.set(MyStage.COUNT, 1000)
+
+
+def test_invalid_default_rejected():
+    with pytest.raises(ValueError):
+        IntParam("bad", "invalid default", -5, ParamValidators.gt(0))
+
+
+def test_get_param_by_name():
+    s = MyStage()
+    assert s.get_param("alpha") is MyStage.ALPHA
+    assert s.get_param("nope") is None
+
+
+def test_undefined_param_rejected():
+    other = IntParam("other", "not on stage", 1)
+    with pytest.raises(ValueError):
+        MyStage().set(other, 3)
+    with pytest.raises(ValueError):
+        MyStage().get(other)
+
+
+def test_json_roundtrip_all_types():
+    s = MyStage()
+    s.set(MyStage.VEC, Vectors.dense(1.0, 2.0))
+    s.set(MyStage.TAGS, ["a", "b"])
+    encoded = {p.name: p.json_encode(v) for p, v in s.get_param_map().items()}
+    # must survive real JSON serialization
+    encoded = json.loads(json.dumps(encoded))
+    t = MyStage()
+    for name, value in encoded.items():
+        p = t.get_param(name)
+        t.set(p, p.json_decode(value))
+    assert t.get(MyStage.VEC) == Vectors.dense(1.0, 2.0)
+    assert t.get(MyStage.TAGS) == ["a", "b"]
+    assert t.get(MyStage.IDS) == [1, 2]
+
+
+def test_sparse_vector_param_roundtrip():
+    s = MyStage()
+    sv = Vectors.sparse(5, [1, 3], [0.5, 1.5])
+    s.set(MyStage.VEC, sv)
+    p = MyStage.VEC
+    decoded = p.json_decode(json.loads(json.dumps(p.json_encode(sv))))
+    assert decoded == sv
+
+
+def test_validators():
+    assert ParamValidators.gt(0).validate(1)
+    assert not ParamValidators.gt(0).validate(0)
+    assert not ParamValidators.gt(0).validate(None)
+    assert ParamValidators.lt_eq(3).validate(3)
+    assert ParamValidators.in_range(0, 1).validate(0.5)
+    assert not ParamValidators.in_range(0, 1, lower_inclusive=False).validate(0)
+    assert ParamValidators.in_array(["a", "b"]).validate("a")
+    assert not ParamValidators.in_array(["a"]).validate("c")
+    assert ParamValidators.non_empty_array().validate([1])
+    assert not ParamValidators.non_empty_array().validate([])
+    assert ParamValidators.is_sub_set(["a", "b", "c"]).validate(["a", "c"])
+    assert not ParamValidators.is_sub_set(["a"]).validate(["z"])
+
+
+def test_param_equality_by_name():
+    a = IntParam("p", "one", 1)
+    b = IntParam("p", "two", 2)
+    assert a == b and hash(a) == hash(b)

@@ -1,0 +1,88 @@
+"""Pipeline / PipelineModel composition + persistence — mirrors
+flink-ml-core PipelineTest and the Python core tests
+(pyflink/ml/core/tests/test_pipeline.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Pipeline, PipelineModel, Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+
+FEATURES = [Vectors.dense(float(i), 2.0) for i in range(1, 6)] + [
+    Vectors.dense(float(i), 2.0) for i in range(11, 16)
+]
+LABELS = [0.0] * 5 + [1.0] * 5
+
+
+def _table():
+    return Table({"features": FEATURES, "label": LABELS})
+
+
+def test_scaler_then_lr_pipeline():
+    pipeline = Pipeline(
+        [
+            StandardScaler().set_input_col("features").set_output_col("scaled"),
+            LogisticRegression().set_features_col("scaled").set_max_iter(60),
+        ]
+    )
+    model = pipeline.fit(_table())
+    assert isinstance(model, PipelineModel)
+    out = model.transform(_table())[0]
+    np.testing.assert_array_equal(np.asarray(out.column("prediction")), LABELS)
+
+
+def test_pipeline_save_load(tmp_path):
+    pipeline = Pipeline(
+        [
+            StandardScaler().set_input_col("features").set_output_col("scaled"),
+            LogisticRegression().set_features_col("scaled").set_max_iter(60),
+        ]
+    )
+    model = pipeline.fit(_table())
+    path = str(tmp_path / "pm")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    out = loaded.transform(_table())[0]
+    np.testing.assert_array_equal(np.asarray(out.column("prediction")), LABELS)
+
+
+def test_pipeline_estimator_save_load(tmp_path):
+    pipeline = Pipeline(
+        [
+            StandardScaler().set_input_col("features").set_output_col("scaled"),
+            LogisticRegression().set_features_col("scaled").set_max_iter(15),
+        ]
+    )
+    path = str(tmp_path / "p")
+    pipeline.save(path)
+    loaded = Pipeline.load(path)
+    assert len(loaded.stages) == 2
+    assert loaded.stages[1].get_max_iter() == 15
+    model = loaded.fit(_table())
+    out = model.transform(_table())[0]
+    assert "prediction" in out
+
+
+def test_pipeline_of_transformers_is_model_like():
+    sc1 = StandardScaler().set_input_col("features").set_output_col("s1")
+    model1 = sc1.fit(_table())
+    pm = PipelineModel([model1])
+    out = pm.transform(_table())[0]
+    assert "s1" in out
+
+
+def test_standard_scaler_values():
+    t = Table({"input": [Vectors.dense(-2.5, 9.0, 1.0), Vectors.dense(-5.0, 0.0, 1.0), Vectors.dense(2.0, -3.0, 1.0)]})
+    model = StandardScaler().fit(t)
+    out = model.transform(t)[0]
+    got = np.asarray(out.column("output"))
+    # expected values from the reference's StandardScalerTest (std-only default)
+    expect_std = np.std([[-2.5, 9, 1], [-5, 0, 1], [2, -3, 1]], axis=0, ddof=1)
+    np.testing.assert_allclose(
+        got, np.array([[-2.5, 9, 1], [-5, 0, 1], [2, -3, 1]]) / np.where(expect_std > 0, expect_std, 1.0),
+        rtol=1e-5,
+    )
+    model2 = StandardScaler().set_with_mean(True).fit(t)
+    out2 = np.asarray(model2.transform(t)[0].column("output"))
+    np.testing.assert_allclose(out2.mean(axis=0), 0.0, atol=1e-6)

@@ -1,0 +1,80 @@
+"""Table / SparseBatch behavior."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import DenseVector, Vectors
+from flink_ml_tpu.table import SparseBatch, StreamTable, Table, as_dense_matrix, as_sparse_batch
+
+
+def test_table_from_dict_and_accessors():
+    t = Table({"a": [1.0, 2.0], "b": ["x", "y"]})
+    assert t.num_rows == 2
+    assert t.column_names == ["a", "b"]
+    assert t.column("a").tolist() == [1.0, 2.0]
+    assert t.column("b")[1] == "y"
+    with pytest.raises(KeyError):
+        t.column("c")
+
+
+def test_row_count_mismatch():
+    with pytest.raises(ValueError):
+        Table({"a": [1.0], "b": [1.0, 2.0]})
+
+
+def test_dense_vector_column_batches():
+    t = Table({"features": [Vectors.dense(1.0, 2.0), Vectors.dense(3.0, 4.0)]})
+    col = t.column("features")
+    assert isinstance(col, np.ndarray) and col.shape == (2, 2)
+    rows = t.collect()
+    assert rows[0]["features"] == Vectors.dense(1.0, 2.0)
+
+
+def test_sparse_vector_column_batches():
+    t = Table(
+        {
+            "features": [
+                Vectors.sparse(4, [0], [1.0]),
+                Vectors.sparse(4, [1, 3], [2.0, 3.0]),
+            ]
+        }
+    )
+    col = t.column("features")
+    assert isinstance(col, SparseBatch)
+    assert col.size == 4
+    np.testing.assert_array_equal(
+        col.to_dense(), [[1.0, 0, 0, 0], [0, 2.0, 0, 3.0]]
+    )
+    assert t.collect()[1]["features"] == Vectors.sparse(4, [1, 3], [2.0, 3.0])
+
+
+def test_with_column_select_drop_rename():
+    t = Table({"a": [1.0, 2.0]})
+    t2 = t.with_column("b", np.array([3.0, 4.0]))
+    assert t2.column_names == ["a", "b"]
+    assert t2.select("b").column_names == ["b"]
+    assert t2.drop("a").column_names == ["b"]
+    assert t2.rename({"a": "z"}).column_names == ["z", "b"]
+
+
+def test_take_head_concat():
+    t = Table({"a": np.arange(10.0)})
+    assert t.head(3).column("a").tolist() == [0.0, 1.0, 2.0]
+    assert t.take(np.array([9, 0])).column("a").tolist() == [9.0, 0.0]
+    both = t.head(2).concat(t.head(1))
+    assert both.column("a").tolist() == [0.0, 1.0, 0.0]
+
+
+def test_as_dense_matrix_coercions():
+    assert as_dense_matrix(np.array([1.0, 2.0])).shape == (2, 1)
+    sb = as_sparse_batch(np.array([[1.0, 0.0], [0.0, 2.0]]))
+    assert isinstance(sb, SparseBatch)
+    np.testing.assert_array_equal(sb.to_dense(), [[1.0, 0.0], [0.0, 2.0]])
+
+
+def test_stream_table():
+    batches = [Table({"a": [1.0]}), Table({"a": [2.0]})]
+    st = StreamTable.from_batches(batches)
+    assert [b.column("a")[0] for b in st] == [1.0, 2.0]
+    # re-iterable when built from a list
+    assert [b.column("a")[0] for b in st] == [1.0, 2.0]
