@@ -13,6 +13,7 @@ mesh data axis — no funnel-to-one-task bottleneck. Termination is maxIter
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List
 
 import jax
@@ -31,7 +32,6 @@ from ...common.param import (
 from ...ops.distance import DistanceMeasure
 from ...param import IntParam, ParamValidators, StringParam
 from ...parallel import mesh as mesh_lib
-from ...parallel.iteration import iterate_bounded
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
 from ...utils.param_utils import update_existing_params
@@ -62,11 +62,20 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         return self.set(self.INIT_MODE, value)
 
 
-def _make_epoch_body(measure: DistanceMeasure, X, weights):
-    """One Lloyd iteration. X is (n, d) sharded over the data axis; the
-    segment-sum contraction over n makes XLA reduce over ICI."""
+@partial(jax.jit, static_argnames=("measure_name",))
+def _lloyd_train(X, weights, init_centroids, max_iter, measure_name):
+    """The full Lloyd loop as one XLA program; X is (n, d) sharded over the
+    data axis, the segment-sum contraction over n makes XLA reduce over ICI.
+    Data and max_iter are runtime arguments so repeated fits with the same
+    shapes reuse the compiled executable."""
+    measure = DistanceMeasure.get_instance(measure_name)
 
-    def body(centroids, _epoch):
+    def cond(state):
+        _, _, epoch = state
+        return epoch < max_iter
+
+    def step(state):
+        centroids, _, epoch = state
         dists = measure.pairwise(X, centroids)  # (n, k)
         assign = jnp.argmin(dists, axis=1)  # (n,)
         one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype)  # (n, k)
@@ -74,11 +83,13 @@ def _make_epoch_body(measure: DistanceMeasure, X, weights):
         counts = jnp.sum(one_hot, axis=0)  # (k,)
         sums = one_hot.T @ X  # (k, d) — MXU matmul doubling as segment-sum
         new_centroids = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-300), centroids
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centroids
         )
-        return new_centroids, (new_centroids, counts)
+        return (new_centroids, counts, epoch + 1)
 
-    return body
+    init = (init_centroids, jnp.zeros(init_centroids.shape[0], X.dtype), jnp.asarray(0, jnp.int32))
+    centroids, counts, _ = jax.lax.while_loop(cond, step, init)
+    return centroids, counts
 
 
 class KMeansModel(Model, KMeansModelParams):
@@ -154,17 +165,13 @@ class KMeans(Estimator, KMeansParams):
         X_dev = jax.device_put(X_pad, NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None)))
         w_dev = jax.device_put(w, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
 
-        measure = DistanceMeasure.get_instance(self.get_distance_measure())
-        epoch = _make_epoch_body(measure, X_dev, w_dev)
-
-        def body(carry, e):
-            centroids, _counts = carry
-            new_centroids, (_, counts) = epoch(centroids, e)
-            return (new_centroids, counts), jnp.asarray(0.0, jnp.float32)
-
-        init_carry = (init_centroids, jnp.zeros((k,), jnp.float32))
-        result = iterate_bounded(body, init_carry, self.get_max_iter())
-        centroids, counts = result.carry
+        centroids, counts = _lloyd_train(
+            X_dev,
+            w_dev,
+            init_centroids,
+            jnp.asarray(self.get_max_iter(), jnp.int32),
+            self.get_distance_measure(),
+        )
 
         model = KMeansModel()
         model.centroids = np.asarray(centroids, dtype=np.float64)

@@ -12,6 +12,11 @@ nothing), and the epoch loop is one XLA while-loop: the gradient
 contraction over the sharded batch axis makes XLA insert the ICI psum that
 replaces AllReduceImpl.java:71-103.
 
+The whole training loop is ONE module-level jitted function whose data and
+hyperparameters are runtime arguments: repeated fits with the same shapes
+reuse the compiled executable (and the persistent compilation cache works
+across processes), so only the first-ever fit pays XLA compile time.
+
 Semantics matched to the reference for loss parity:
 - batch k = rows [k*B, (k+1)*B) cycling, B = globalBatchSize;
 - update: coeff -= lr/totalWeight * grad, then proximal regularization
@@ -25,37 +30,90 @@ Semantics matched to the reference for loss parity:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
-from ..parallel.iteration import iterate_bounded
 from .losses import LossFunc
 
 
-def regularize(coeff, reg: float, elastic_net: float, learning_rate: float):
+def regularize(coeff, reg, elastic_net, learning_rate):
     """Proximal regularization step; returns (new_coeff, reg_loss).
 
-    Matches RegularizationUtils.regularize exactly, including its use of the
-    (unsquared) L2 norm in the reported L2 loss. `reg`/`elastic_net` are
-    static Python floats, so the branch resolves at trace time.
+    Matches RegularizationUtils.regularize, including its use of the
+    (unsquared) L2 norm in the reported L2 loss. All arguments may be traced
+    values — branch selection is by jnp.where so one compiled program covers
+    every (reg, elasticNet) configuration.
     """
-    if reg == 0.0:
-        return coeff, jnp.asarray(0.0, coeff.dtype)
-    if elastic_net == 0.0:
-        loss = reg / 2.0 * jnp.linalg.norm(coeff)
-        return coeff * (1.0 - learning_rate * reg), loss
+    reg = jnp.asarray(reg, coeff.dtype)
+    en = jnp.asarray(elastic_net, coeff.dtype)
     sign = jnp.sign(coeff)
-    if elastic_net == 1.0:
-        loss = jnp.sum(elastic_net * reg * sign)
-        return coeff - learning_rate * elastic_net * reg * sign, loss
-    loss = jnp.sum(elastic_net * reg * sign + (1 - elastic_net) * (reg / 2.0) * coeff * coeff)
-    step = learning_rate * (elastic_net * reg * sign + (1 - elastic_net) * reg * coeff)
-    return coeff - step, loss
+    # The single proximal formula specializes to each reference branch:
+    # en=0 -> coeff*(1 - lr*reg); en=1 -> coeff - lr*reg*sign; else mixed.
+    step = learning_rate * (en * reg * sign + (1.0 - en) * reg * coeff)
+    new_coeff = jnp.where(reg > 0.0, coeff - step, coeff)
+    l2_only = reg / 2.0 * jnp.linalg.norm(coeff)
+    l1_only = jnp.sum(en * reg * sign)
+    mixed = jnp.sum(en * reg * sign + (1.0 - en) * (reg / 2.0) * coeff * coeff)
+    loss = jnp.where(
+        reg == 0.0, 0.0, jnp.where(en == 0.0, l2_only, jnp.where(en == 1.0, l1_only, mixed))
+    )
+    return new_coeff, loss
+
+
+def _update_model(coeff, grad, wsum, lr, reg, elastic_net):
+    def do_update(c):
+        c = c - (lr / jnp.maximum(wsum, 1e-30)) * grad
+        c, _ = regularize(c, reg, elastic_net, lr)
+        return c
+
+    return lax.cond(wsum > 0, do_update, lambda c: c, coeff)
+
+
+@partial(jax.jit, static_argnames=("loss_func",))
+def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, elastic_net):
+    """The full bounded training iteration as one XLA program.
+
+    State machine mirrors SGD.java's CacheDataAndDoTrain: each epoch first
+    applies the gradient reduced in the previous epoch, then computes the
+    gradient of the next batch; one extra update lands after termination.
+    Returns (final_coeff, final_loss, num_epochs).
+    """
+    num_batches = X_b.shape[0]
+    d = X_b.shape[-1]
+    dtype = X_b.dtype
+
+    def cond(state):
+        _, _, _, epoch, criteria = state
+        return jnp.logical_and(epoch < max_iter, criteria > tol)
+
+    def body(state):
+        coeff, grad, wsum, epoch, _ = state
+        coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+        k = jnp.mod(epoch, num_batches)
+        Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
+        yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
+        wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
+        lsum, grad, wsum = loss_func(Xk, yk, wk, coeff)
+        criteria = lsum / jnp.maximum(wsum, 1e-30)
+        return (coeff, grad, wsum, epoch + 1, jnp.asarray(criteria, jnp.float32))
+
+    init_state = (
+        jnp.asarray(init_coeff, dtype),
+        jnp.zeros((d,), dtype),
+        jnp.asarray(0.0, dtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+    coeff, grad, wsum, epochs, criteria = lax.while_loop(cond, body, init_state)
+    coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    return coeff, criteria, epochs
 
 
 @dataclass
@@ -82,39 +140,19 @@ class SGD:
         """Returns (final_coefficient, final_loss, num_epochs)."""
         mesh = mesh or mesh_lib.default_mesh()
         X_b, y_b, w_b = self._batchify(mesh, X, y, weights)
-        d = X_b.shape[-1]
-        num_batches = X_b.shape[0]
-        lr, reg_p, en = self.learning_rate, self.reg, self.elastic_net
-
-        def update_model(coeff, grad, wsum):
-            def do_update(c):
-                c = c - (lr / jnp.maximum(wsum, 1e-300)) * grad
-                c, _ = regularize(c, reg_p, en, lr)
-                return c
-
-            return jax.lax.cond(wsum > 0, do_update, lambda c: c, coeff)
-
-        def body(carry, epoch):
-            coeff, grad, wsum, _ = carry
-            coeff = update_model(coeff, grad, wsum)
-            k = jnp.mod(epoch, num_batches)
-            Xk = jax.lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
-            yk = jax.lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
-            wk = jax.lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
-            lsum, grad, wsum = loss_func(Xk, yk, wk, coeff)
-            criteria = lsum / jnp.maximum(wsum, 1e-300)
-            return (coeff, grad, wsum, lsum), criteria
-
-        init_carry = (
+        coeff, criteria, epochs = _sgd_train(
+            X_b,
+            y_b,
+            w_b,
             jnp.asarray(init_coeff, self.dtype),
-            jnp.zeros((d,), self.dtype),
-            jnp.asarray(0.0, self.dtype),
-            jnp.asarray(0.0, self.dtype),
+            loss_func,
+            jnp.asarray(self.max_iter, jnp.int32),
+            jnp.asarray(self.tol, jnp.float32),
+            jnp.asarray(self.learning_rate, self.dtype),
+            jnp.asarray(self.reg, self.dtype),
+            jnp.asarray(self.elastic_net, self.dtype),
         )
-        result = iterate_bounded(body, init_carry, self.max_iter, tol=self.tol)
-        coeff, grad, wsum, _ = result.carry
-        coeff = jax.jit(update_model)(coeff, grad, wsum)
-        return np.asarray(coeff), result.final_criteria, result.num_epochs
+        return np.asarray(coeff), float(criteria), int(epochs)
 
     def _batchify(self, mesh: Mesh, X, y, weights):
         """Pad + reshape host data into device-resident
