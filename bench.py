@@ -106,7 +106,10 @@ def main(argv):
     skip_logreg = "--skip-logreg" in argv
     logreg_rows = 10_000_000
     if "--logreg-rows" in argv:
-        logreg_rows = int(argv[argv.index("--logreg-rows") + 1])
+        try:
+            logreg_rows = int(argv[argv.index("--logreg-rows") + 1])
+        except (IndexError, ValueError):
+            print("--logreg-rows needs an integer; using default", file=sys.stderr)
 
     kmeans = bench_kmeans()
     print(

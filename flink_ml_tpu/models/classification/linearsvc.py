@@ -1,0 +1,126 @@
+"""LinearSVC — linear support vector classifier trained with distributed SGD.
+
+TPU-native re-design of classification/linearsvc/LinearSVC.java,
+LinearSVCModel.java:137-173 and LinearSVCModelParams.java:36-52 (hinge loss
++ threshold on the raw dot value; rawPrediction = [dot, -dot]).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasRawPredictionCol,
+    HasReg,
+    HasTol,
+    HasWeightCol,
+)
+from ...ops.losses import HINGE_LOSS
+from ...param import FloatParam
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+from .. import _linear
+
+
+class LinearSVCModelParams(HasFeaturesCol, HasPredictionCol, HasRawPredictionCol):
+    THRESHOLD = FloatParam(
+        "threshold",
+        "Threshold in binary classification prediction applied to rawPrediction.",
+        0.0,
+    )
+
+    def get_threshold(self) -> float:
+        return self.get(self.THRESHOLD)
+
+    def set_threshold(self, value: float):
+        return self.set(self.THRESHOLD, value)
+
+
+class LinearSVCParams(
+    LinearSVCModelParams,
+    HasLabelCol,
+    HasWeightCol,
+    HasMaxIter,
+    HasReg,
+    HasElasticNet,
+    HasLearningRate,
+    HasGlobalBatchSize,
+    HasTol,
+):
+    pass
+
+
+@jax.jit
+def _predict(X, coeff, threshold):
+    """prediction = dot >= threshold ? 1 : 0; rawPrediction = [dot, -dot]
+    (LinearSVCModel.predictOneDataPoint:170-173)."""
+    dot = X @ coeff
+    pred = jnp.where(dot >= threshold, 1.0, 0.0)
+    raw = jnp.stack([dot, -dot], axis=1)
+    return pred, raw
+
+
+class LinearSVCModel(Model, LinearSVCModelParams):
+    def __init__(self):
+        self.coefficient: np.ndarray = None  # (d,)
+
+    def set_model_data(self, *inputs: Table) -> "LinearSVCModel":
+        (model_data,) = inputs
+        rows = model_data.collect()
+        self.coefficient = np.asarray(rows[0]["coefficient"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [Table({"coefficient": [DenseVector(self.coefficient)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        pred, raw = _predict(
+            jnp.asarray(X, jnp.float32),
+            jnp.asarray(self.coefficient, jnp.float32),
+            jnp.asarray(self.get_threshold(), jnp.float32),
+        )
+        return [
+            table.with_columns(
+                {
+                    self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
+                    self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
+                }
+            )
+        ]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, coefficient=self.coefficient)
+
+    def _load_extra(self, path: str) -> None:
+        self.coefficient = read_write.load_model_arrays(path)["coefficient"]
+
+
+class LinearSVC(Estimator, LinearSVCParams):
+    """Estimator (LinearSVC.java)."""
+
+    def fit(self, *inputs: Table) -> LinearSVCModel:
+        (table,) = inputs
+        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        _linear.validate_binomial_labels(y)
+        coeff, _, _ = _linear.run_sgd(self, table, HINGE_LOSS, self.get_weight_col())
+        model = LinearSVCModel()
+        model.coefficient = coeff
+        update_existing_params(model, self)
+        return model

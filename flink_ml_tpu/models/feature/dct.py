@@ -1,0 +1,57 @@
+"""DCT — 1-D discrete cosine transform (DCT-II / DCT-III) of each vector.
+
+TPU-native re-design of feature/dct/DCT.java + DCTParams.java (`inverse`).
+The reference uses jtransforms' scaled DCT (orthonormal). Here the whole
+column is transformed with ONE matmul against the precomputed orthonormal
+DCT basis — an MXU-friendly formulation (n is feature dim, typically small;
+for large n an FFT-based pallas path could replace this).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import BooleanParam
+from ...table import Table, as_dense_matrix
+
+
+class DCTParams(HasInputCol, HasOutputCol):
+    INVERSE = BooleanParam(
+        "inverse",
+        "Whether to perform the inverse DCT (true) or forward DCT (false).",
+        False,
+    )
+
+    def get_inverse(self) -> bool:
+        return self.get(self.INVERSE)
+
+    def set_inverse(self, value: bool):
+        return self.set(self.INVERSE, value)
+
+
+@lru_cache(maxsize=16)
+def _dct_basis(n: int) -> np.ndarray:
+    """Orthonormal DCT-II matrix B: y = B @ x."""
+    k = np.arange(n)[:, None]
+    i = np.arange(n)[None, :]
+    B = np.cos(np.pi * k * (2 * i + 1) / (2.0 * n))
+    B *= np.sqrt(2.0 / n)
+    B[0] /= np.sqrt(2.0)
+    return B
+
+
+class DCT(Transformer, DCTParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        B = _dct_basis(X.shape[1])
+        mat = B.T if self.get_inverse() else B
+        out = jax.jit(jnp.matmul)(jnp.asarray(X), jnp.asarray(mat.T))
+        return [table.with_column(self.get_output_col(), np.asarray(out))]

@@ -1,0 +1,54 @@
+"""ElementwiseProduct — Hadamard product of each vector with a scaling vector.
+
+TPU-native re-design of feature/elementwiseproduct/ElementwiseProduct.java +
+ElementwiseProductParams.java (`scalingVec`, required). One broadcasted
+multiply over the column.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import ParamValidators, VectorParam
+from ...table import SparseBatch, Table, as_dense_matrix
+
+
+class ElementwiseProductParams(HasInputCol, HasOutputCol):
+    SCALING_VEC = VectorParam(
+        "scalingVec",
+        "The scaling vector to multiply with input vectors using hadamard product.",
+        None,
+        ParamValidators.not_null(),
+    )
+
+    def get_scaling_vec(self):
+        return self.get(self.SCALING_VEC)
+
+    def set_scaling_vec(self, value):
+        return self.set(self.SCALING_VEC, value)
+
+
+class ElementwiseProduct(Transformer, ElementwiseProductParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        scaling = self.get_scaling_vec()
+        if scaling is None:
+            raise ValueError("Parameter scalingVec must be set")
+        sv = np.asarray(scaling.to_array(), dtype=np.float64)
+        col = table.column(self.get_input_col())
+        if isinstance(col, SparseBatch):
+            # Multiply only the stored entries; padded slots (index -1) keep 0.
+            gathered = np.where(col.indices >= 0, sv[np.clip(col.indices, 0, None)], 0.0)
+            out = SparseBatch(col.size, col.indices.copy(), col.values * gathered)
+        else:
+            X = as_dense_matrix(col)
+            if X.shape[1] != sv.shape[0]:
+                raise ValueError(
+                    f"Vector size {X.shape[1]} does not match scalingVec size {sv.shape[0]}"
+                )
+            out = X * sv[None, :]
+        return [table.with_column(self.get_output_col(), out)]

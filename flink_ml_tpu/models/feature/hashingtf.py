@@ -1,0 +1,65 @@
+"""HashingTF — maps term sequences to sparse term-frequency vectors via the
+hashing trick.
+
+TPU-native re-design of feature/hashingtf/HashingTF.java:125-185 (guava
+murmur3_32(0) term hashing — matched bit-for-bit by utils/hashing.py — and
+nonNegativeMod bucketing; `binary` caps frequencies at 1;
+`numFeatures` default 262144). Hashing is host-side (string work); the
+output SparseBatch feeds batched device compute downstream.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasNumFeatures, HasOutputCol
+from ...param import BooleanParam
+from ...table import SparseBatch, Table
+from ...utils.hashing import hash_term
+
+
+class HashingTFParams(HasInputCol, HasOutputCol, HasNumFeatures):
+    BINARY = BooleanParam(
+        "binary", "Whether each dimension of the output vector is binary or not.", False
+    )
+
+    def get_binary(self) -> bool:
+        return self.get(self.BINARY)
+
+    def set_binary(self, value: bool):
+        return self.set(self.BINARY, value)
+
+
+class HashingTF(Transformer, HashingTFParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        col = table.column(self.get_input_col())
+        n_features = self.get_num_features()
+        binary = self.get_binary()
+        row_indices: List[np.ndarray] = []
+        row_values: List[np.ndarray] = []
+        max_nnz = 1
+        for terms in col:
+            counts = {}
+            for term in terms:
+                idx = hash_term(term) % n_features
+                counts[idx] = 1 if binary else counts.get(idx, 0) + 1
+            idx_arr = np.fromiter(sorted(counts), dtype=np.int32, count=len(counts))
+            val_arr = np.asarray([counts[i] for i in sorted(counts)], dtype=np.float64)
+            row_indices.append(idx_arr)
+            row_values.append(val_arr)
+            max_nnz = max(max_nnz, len(idx_arr))
+        n = len(row_indices)
+        indices = np.full((n, max_nnz), -1, dtype=np.int32)
+        values = np.zeros((n, max_nnz), dtype=np.float64)
+        for i, (ia, va) in enumerate(zip(row_indices, row_values)):
+            indices[i, : ia.size] = ia
+            values[i, : va.size] = va
+        return [
+            table.with_column(
+                self.get_output_col(), SparseBatch(n_features, indices, values)
+            )
+        ]

@@ -1,0 +1,35 @@
+"""Interaction — elementwise product space of multiple columns.
+
+TPU-native re-design of feature/interaction/Interaction.java (output vector
+= flattened outer product of the input columns' vectors, earlier columns
+varying slowest — the dense path of InteractionFunction; numbers are treated
+as 1-dim vectors). Batched as one einsum-style chained outer product.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCols, HasOutputCol
+from ...table import Table, as_dense_matrix
+
+
+class InteractionParams(HasInputCols, HasOutputCol):
+    pass
+
+
+class Interaction(Transformer, InteractionParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            raise ValueError("Parameter inputCols must be set")
+        mats = [as_dense_matrix(table.column(name)) for name in in_cols]
+        out = mats[0]
+        for m in mats[1:]:
+            # (n, a) x (n, b) -> (n, a*b), earlier columns vary slowest.
+            out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+        return [table.with_column(self.get_output_col(), out)]

@@ -1,0 +1,40 @@
+"""NGram — converts token arrays into space-joined n-grams.
+
+TPU-native re-design of feature/ngram/NGram.java + NGramParams.java (`n`
+default 2; inputs shorter than n produce an empty array).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import IntParam, ParamValidators
+from ...table import Table
+
+
+class NGramParams(HasInputCol, HasOutputCol):
+    N = IntParam("n", "Number of elements per n-gram (>=1).", 2, ParamValidators.gt_eq(1))
+
+    def get_n(self) -> int:
+        return self.get(self.N)
+
+    def set_n(self, value: int):
+        return self.set(self.N, value)
+
+
+class NGram(Transformer, NGramParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        n = self.get_n()
+        col = table.column(self.get_input_col())
+        out = np.empty(len(col), dtype=object)
+        for i, tokens in enumerate(col):
+            tokens = list(tokens)
+            out[i] = [
+                " ".join(tokens[j : j + n]) for j in range(len(tokens) - n + 1)
+            ]
+        return [table.with_column(self.get_output_col(), out)]

@@ -1,0 +1,64 @@
+"""PolynomialExpansion — expands vectors into polynomial feature space.
+
+TPU-native re-design of feature/polynomialexpansion/PolynomialExpansion.java
+(recursion documented at :103-117: f([a,b,c],3) = f([a,b],3) ++ f([a,b],2)*c
+++ f([a,b],1)*c^2 ++ [c^3]; output excludes the constant term, size =
+C(size+degree, degree) - 1). Same recursion here, but over whole COLUMNS:
+each emitted monomial is one vectorized product over the batch.
+"""
+
+from __future__ import annotations
+
+from math import comb
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import IntParam, ParamValidators
+from ...table import Table, as_dense_matrix
+
+
+class PolynomialExpansionParams(HasInputCol, HasOutputCol):
+    DEGREE = IntParam(
+        "degree", "Degree of the polynomial expansion.", 2, ParamValidators.gt_eq(1)
+    )
+
+    def get_degree(self) -> int:
+        return self.get(self.DEGREE)
+
+    def set_degree(self, value: int):
+        return self.set(self.DEGREE, value)
+
+
+def _expand_columns(X: np.ndarray, degree: int) -> np.ndarray:
+    """Emit monomial columns in the reference's recursion order
+    (PolynomialExpansion.expandDenseVector:211-242), batched over rows."""
+    n_rows, size = X.shape
+    out: List[np.ndarray] = []
+
+    def expand(last_idx: int, deg: int, factor: np.ndarray) -> None:
+        if deg == 0 or last_idx < 0:
+            out.append(factor)
+            return
+        v = X[:, last_idx]
+        alpha = factor
+        for i in range(deg + 1):
+            expand(last_idx - 1, deg - i, alpha)
+            alpha = alpha * v
+
+    expand(size - 1, degree, np.ones(n_rows, dtype=X.dtype))
+    # The first emitted column is the constant term, excluded by the
+    # reference (curPolyIdx starts at -1).
+    result = np.stack(out[1:], axis=1)
+    assert result.shape[1] == comb(size + degree, degree) - 1
+    return result
+
+
+class PolynomialExpansion(Transformer, PolynomialExpansionParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        out = _expand_columns(X, self.get_degree())
+        return [table.with_column(self.get_output_col(), out)]

@@ -1,0 +1,72 @@
+"""RegexTokenizer — regex-based tokenization.
+
+TPU-native re-design of feature/regextokenizer/RegexTokenizer.java +
+RegexTokenizerParams.java (`pattern` default "\\s+", `gaps` — pattern
+matches separators (true) or tokens (false), `minTokenLength`,
+`toLowercase`).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import BooleanParam, IntParam, ParamValidators, StringParam
+from ...table import Table
+
+
+class RegexTokenizerParams(HasInputCol, HasOutputCol):
+    MIN_TOKEN_LENGTH = IntParam(
+        "minTokenLength", "Minimum token length", 1, ParamValidators.gt_eq(0)
+    )
+    GAPS = BooleanParam("gaps", "Set regex to match gaps or tokens", True)
+    PATTERN = StringParam("pattern", "Regex pattern used for tokenizing", r"\s+")
+    TO_LOWERCASE = BooleanParam(
+        "toLowercase",
+        "Whether to convert all characters to lowercase before tokenizing",
+        True,
+    )
+
+    def get_min_token_length(self) -> int:
+        return self.get(self.MIN_TOKEN_LENGTH)
+
+    def set_min_token_length(self, value: int):
+        return self.set(self.MIN_TOKEN_LENGTH, value)
+
+    def get_gaps(self) -> bool:
+        return self.get(self.GAPS)
+
+    def set_gaps(self, value: bool):
+        return self.set(self.GAPS, value)
+
+    def get_pattern(self) -> str:
+        return self.get(self.PATTERN)
+
+    def set_pattern(self, value: str):
+        return self.set(self.PATTERN, value)
+
+    def get_to_lowercase(self) -> bool:
+        return self.get(self.TO_LOWERCASE)
+
+    def set_to_lowercase(self, value: bool):
+        return self.set(self.TO_LOWERCASE, value)
+
+
+class RegexTokenizer(Transformer, RegexTokenizerParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        pattern = re.compile(self.get_pattern())
+        gaps = self.get_gaps()
+        min_len = self.get_min_token_length()
+        lower = self.get_to_lowercase()
+        col = table.column(self.get_input_col())
+        out = np.empty(len(col), dtype=object)
+        for i, s in enumerate(col):
+            text = str(s).lower() if lower else str(s)
+            tokens = pattern.split(text) if gaps else pattern.findall(text)
+            out[i] = [t for t in tokens if len(t) >= min_len]
+        return [table.with_column(self.get_output_col(), out)]

@@ -1,0 +1,36 @@
+"""Tokenizer — lowercases and splits strings on whitespace.
+
+TPU-native re-design of feature/tokenizer/Tokenizer.java
+(`input.toLowerCase().split("\\s")`). String work stays host-side; the
+token arrays feed HashingTF/CountVectorizer for device compute.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...table import Table
+
+
+class TokenizerParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class Tokenizer(Transformer, TokenizerParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        col = table.column(self.get_input_col())
+        out = np.empty(len(col), dtype=object)
+        for i, s in enumerate(col):
+            # Java String.split("\\s") keeps empty tokens between separators
+            # but drops trailing empties.
+            tokens = re.split(r"\s", str(s).lower())
+            while tokens and tokens[-1] == "":
+                tokens.pop()
+            out[i] = tokens
+        return [table.with_column(self.get_output_col(), out)]

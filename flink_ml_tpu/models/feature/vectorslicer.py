@@ -1,0 +1,59 @@
+"""VectorSlicer — selects a sub-vector of features by index.
+
+TPU-native re-design of feature/vectorslicer/VectorSlicer.java +
+VectorSlicerParams.java (`indices`: non-negative, unique). One fancy-index
+gather over the column.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import IntArrayParam, ParamValidators
+from ...table import Table, as_dense_matrix
+
+
+def _indices_validator():
+    def check(v):
+        if v is None or len(v) == 0:
+            return False
+        vals = list(v)
+        return all(i >= 0 for i in vals) and len(set(vals)) == len(vals)
+
+    from ...param import ParamValidator
+
+    return ParamValidator(check, "non-empty, unique, non-negative indices")
+
+
+class VectorSlicerParams(HasInputCol, HasOutputCol):
+    INDICES = IntArrayParam(
+        "indices",
+        "An array of indices to select features from a vector column.",
+        None,
+        _indices_validator(),
+    )
+
+    def get_indices(self):
+        return self.get(self.INDICES)
+
+    def set_indices(self, *values: int):
+        return self.set(self.INDICES, list(values))
+
+
+class VectorSlicer(Transformer, VectorSlicerParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        indices = self.get_indices()
+        if indices is None:
+            raise ValueError("Parameter indices must be set")
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.max() >= X.shape[1]:
+            raise ValueError(
+                f"Index {int(idx.max())} out of range for vector size {X.shape[1]}"
+            )
+        return [table.with_column(self.get_output_col(), X[:, idx])]

@@ -1,0 +1,98 @@
+"""LinearRegression — least-squares linear model trained with distributed SGD.
+
+TPU-native re-design of regression/linearregression/LinearRegression.java:48
+and LinearRegressionModel.java:146-160. Shares the SGD engine with the other
+linear models; inference is one jitted matvec over the whole table
+(predictOneDataPoint's per-row BLAS.dot becomes an MXU matmul).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import (
+    HasElasticNet,
+    HasFeaturesCol,
+    HasGlobalBatchSize,
+    HasLabelCol,
+    HasLearningRate,
+    HasMaxIter,
+    HasPredictionCol,
+    HasReg,
+    HasTol,
+    HasWeightCol,
+)
+from ...ops.losses import LEAST_SQUARE_LOSS
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+from .. import _linear
+
+
+class LinearRegressionModelParams(HasFeaturesCol, HasPredictionCol):
+    pass
+
+
+class LinearRegressionParams(
+    LinearRegressionModelParams,
+    HasLabelCol,
+    HasWeightCol,
+    HasMaxIter,
+    HasReg,
+    HasElasticNet,
+    HasLearningRate,
+    HasGlobalBatchSize,
+    HasTol,
+):
+    pass
+
+
+class LinearRegressionModel(Model, LinearRegressionModelParams):
+    def __init__(self):
+        self.coefficient: np.ndarray = None  # (d,)
+
+    def set_model_data(self, *inputs: Table) -> "LinearRegressionModel":
+        (model_data,) = inputs
+        rows = model_data.collect()
+        self.coefficient = np.asarray(rows[0]["coefficient"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [Table({"coefficient": [DenseVector(self.coefficient)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        pred = jax.jit(jnp.matmul)(
+            jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32)
+        )
+        return [
+            table.with_column(self.get_prediction_col(), np.asarray(pred, dtype=np.float64))
+        ]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, coefficient=self.coefficient)
+
+    def _load_extra(self, path: str) -> None:
+        self.coefficient = read_write.load_model_arrays(path)["coefficient"]
+
+
+class LinearRegression(Estimator, LinearRegressionParams):
+    """Estimator (LinearRegression.java:48)."""
+
+    def fit(self, *inputs: Table) -> LinearRegressionModel:
+        (table,) = inputs
+        coeff, _, _ = _linear.run_sgd(
+            self, table, LEAST_SQUARE_LOSS, self.get_weight_col()
+        )
+        model = LinearRegressionModel()
+        model.coefficient = coeff
+        update_existing_params(model, self)
+        return model

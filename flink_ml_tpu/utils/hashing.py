@@ -1,0 +1,100 @@
+"""Guava-compatible MurmurHash3 (32-bit, seed 0) for the hashing trick.
+
+The reference hashes terms with guava's murmur3_32(0)
+(feature/hashingtf/HashingTF.java:45,60-61,160-185: hashUnencodedChars for
+String, hashInt/hashLong for numerics). Re-implemented from the public
+MurmurHash3 spec so hashed feature indices match the reference exactly.
+"""
+
+from __future__ import annotations
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M = 0xFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _mix_k1(k1: int) -> int:
+    k1 = (k1 * _C1) & _M
+    k1 = _rotl(k1, 15)
+    return (k1 * _C2) & _M
+
+
+def _mix_h1(h1: int, k1: int) -> int:
+    h1 ^= k1
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _M
+
+
+def _fmix(h1: int, length: int) -> int:
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M
+    h1 ^= h1 >> 16
+    return h1
+
+
+def _to_signed(x: int) -> int:
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def murmur3_hash_int(value: int, seed: int = 0) -> int:
+    """guava Murmur3_32.hashInt: one 4-byte block."""
+    h1 = _mix_h1(seed & _M, _mix_k1(value & _M))
+    return _to_signed(_fmix(h1, 4))
+
+
+def murmur3_hash_long(value: int, seed: int = 0) -> int:
+    """guava Murmur3_32.hashLong: low int then high int."""
+    value &= 0xFFFFFFFFFFFFFFFF
+    low = value & _M
+    high = (value >> 32) & _M
+    h1 = _mix_h1(seed & _M, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _to_signed(_fmix(h1, 8))
+
+
+def murmur3_hash_unencoded_chars(s: str, seed: int = 0) -> int:
+    """guava Murmur3_32.hashUnencodedChars: UTF-16 code units, 2 per block."""
+    # Java strings are UTF-16: astral chars must become surrogate pairs.
+    units = []
+    for c in s:
+        cp = ord(c)
+        if cp > 0xFFFF:
+            cp -= 0x10000
+            units.append(0xD800 + (cp >> 10))
+            units.append(0xDC00 + (cp & 0x3FF))
+        else:
+            units.append(cp)
+    h1 = seed & _M
+    for i in range(0, len(units) - 1, 2):
+        k1 = units[i] | (units[i + 1] << 16)
+        h1 = _mix_h1(h1, _mix_k1(k1))
+    if len(units) % 2 == 1:
+        h1 ^= _mix_k1(units[-1])
+    return _to_signed(_fmix(h1, 2 * len(units)))
+
+
+def hash_term(obj, seed: int = 0) -> int:
+    """Dispatch by type like HashingTF.hash (HashingTF.java:160-185)."""
+    import struct
+
+    if obj is None:
+        return 0
+    if isinstance(obj, bool):
+        return murmur3_hash_int(1 if obj else 0, seed)
+    if isinstance(obj, int):
+        if -(2**31) <= obj < 2**31:
+            return murmur3_hash_int(obj, seed)
+        return murmur3_hash_long(obj, seed)
+    if isinstance(obj, float):
+        bits = struct.unpack("<q", struct.pack("<d", obj))[0]
+        return murmur3_hash_long(bits, seed)
+    if isinstance(obj, str):
+        return murmur3_hash_unencoded_chars(obj, seed)
+    raise TypeError(f"Unsupported term type {type(obj).__name__} for hashing")

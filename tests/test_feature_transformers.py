@@ -1,0 +1,342 @@
+"""Stateless feature-transformer battery — golden values mirror the
+reference tests under flink-ml-lib/src/test/java/org/apache/flink/ml/feature/
+(BinarizerTest, BucketizerTest, NormalizerTest, ElementwiseProductTest,
+PolynomialExpansionTest, InteractionTest, DCTTest, VectorAssemblerTest,
+VectorSlicerTest, HashingTFTest, TokenizerTest, RegexTokenizerTest,
+NGramTest, StopWordsRemoverTest, RandomSplitterTest)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.models.feature.binarizer import Binarizer
+from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+from flink_ml_tpu.models.feature.dct import DCT
+from flink_ml_tpu.models.feature.elementwiseproduct import ElementwiseProduct
+from flink_ml_tpu.models.feature.hashingtf import HashingTF
+from flink_ml_tpu.models.feature.interaction import Interaction
+from flink_ml_tpu.models.feature.ngram import NGram
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+from flink_ml_tpu.models.feature.polynomialexpansion import PolynomialExpansion
+from flink_ml_tpu.models.feature.randomsplitter import RandomSplitter
+from flink_ml_tpu.models.feature.regextokenizer import RegexTokenizer
+from flink_ml_tpu.models.feature.stopwordsremover import StopWordsRemover
+from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+from flink_ml_tpu.models.feature.vectorassembler import VectorAssembler
+from flink_ml_tpu.models.feature.vectorslicer import VectorSlicer
+
+
+class TestBinarizer:
+    def test_transform(self):
+        t = Table({"f0": [1.0, 2.0, 3.0], "v": [Vectors.dense(1, 2), Vectors.dense(2, 1), Vectors.dense(0, 0)]})
+        out = Binarizer().set_input_cols("f0", "v").set_output_cols("o0", "ov").set_thresholds(1.5, 1.0).transform(t)[0]
+        np.testing.assert_array_equal(np.asarray(out.column("o0")), [0.0, 1.0, 1.0])
+        np.testing.assert_array_equal(np.asarray(out.column("ov")), [[0, 1], [1, 0], [0, 0]])
+
+    def test_save_load(self, tmp_path):
+        b = Binarizer().set_input_cols("a").set_output_cols("b").set_thresholds(0.5)
+        b.save(str(tmp_path / "bin"))
+        loaded = Binarizer.load(str(tmp_path / "bin"))
+        assert loaded.get_thresholds() == [0.5]
+
+
+class TestBucketizer:
+    # BucketizerTest.java inputData/splitsArray
+    SPLITS = [
+        [-0.5, 0.0, 0.5],
+        [-1.0, 0.0, 2.0],
+        [float("-inf"), 10.0, float("inf")],
+        [float("-inf"), 1.5, float("inf")],
+    ]
+
+    def _table(self):
+        return Table(
+            {
+                "f1": [-0.5, float("-inf"), float("nan")],
+                "f2": [0.0, 1.0, -0.5],
+                "f3": [1.0, float("inf"), -0.5],
+                "f4": [0.0, 1.0, 2.0],
+            }
+        )
+
+    def _op(self, handle):
+        return (
+            Bucketizer()
+            .set_input_cols("f1", "f2", "f3", "f4")
+            .set_output_cols("o1", "o2", "o3", "o4")
+            .set_splits_array(self.SPLITS)
+            .set_handle_invalid(handle)
+        )
+
+    def test_keep(self):
+        out = self._op("keep").transform(self._table())[0]
+        np.testing.assert_array_equal(np.asarray(out.column("o1")), [0, 2, 2])
+        np.testing.assert_array_equal(np.asarray(out.column("o2")), [1, 1, 0])
+        np.testing.assert_array_equal(np.asarray(out.column("o3")), [0, 1, 0])
+        np.testing.assert_array_equal(np.asarray(out.column("o4")), [0, 0, 1])
+
+    def test_skip(self):
+        out = self._op("skip").transform(self._table())[0]
+        assert out.num_rows == 1  # only the first row is fully valid
+
+    def test_error(self):
+        with pytest.raises(ValueError):
+            self._op("error").transform(self._table())
+
+    def test_invalid_splits(self):
+        with pytest.raises(ValueError):
+            Bucketizer().set_splits_array([[0.0, 1.0]])
+
+
+class TestNormalizer:
+    def test_l2(self):
+        t = Table({"vec": [Vectors.dense(3, 4), Vectors.dense(0, 5)]})
+        out = Normalizer().set_input_col("vec").set_output_col("o").transform(t)[0]
+        np.testing.assert_allclose(
+            np.asarray(out.column("o")), [[0.6, 0.8], [0.0, 1.0]], atol=1e-6
+        )
+
+    def test_l1(self):
+        t = Table({"vec": [Vectors.dense(1, 3)]})
+        out = Normalizer().set_input_col("vec").set_output_col("o").set_p(1.0).transform(t)[0]
+        np.testing.assert_allclose(np.asarray(out.column("o")), [[0.25, 0.75]], atol=1e-6)
+
+
+class TestElementwiseProduct:
+    def test_transform(self):
+        t = Table({"vec": [Vectors.dense(2.1, 3.1), Vectors.dense(1.1, 3.3)]})
+        op = (
+            ElementwiseProduct()
+            .set_input_col("vec")
+            .set_output_col("o")
+            .set_scaling_vec(Vectors.dense(1.1, 1.1))
+        )
+        out = op.transform(t)[0]
+        np.testing.assert_allclose(
+            np.asarray(out.column("o")), [[2.31, 3.41], [1.21, 3.63]], atol=1e-6
+        )
+
+    def test_save_load(self, tmp_path):
+        op = ElementwiseProduct().set_scaling_vec(Vectors.dense(1.0, 2.0))
+        op.save(str(tmp_path / "ewp"))
+        loaded = ElementwiseProduct.load(str(tmp_path / "ewp"))
+        np.testing.assert_array_equal(loaded.get_scaling_vec().to_array(), [1.0, 2.0])
+
+
+class TestPolynomialExpansion:
+    def test_degree2(self):
+        # PolynomialExpansionTest EXPECTED_DENSE_OUTPUT
+        t = Table({"vec": [Vectors.dense(1, 2, 3)]})
+        out = PolynomialExpansion().set_input_col("vec").set_output_col("o").transform(t)[0]
+        np.testing.assert_allclose(
+            np.asarray(out.column("o"))[0], [1, 1, 2, 2, 4, 3, 3, 6, 9], atol=1e-9
+        )
+
+    def test_degree3(self):
+        # EXPECTED_DENSE_OUTPUT_WITH_DEGREE_3 row 2
+        t = Table({"vec": [Vectors.dense(2, 3)]})
+        out = (
+            PolynomialExpansion().set_input_col("vec").set_output_col("o").set_degree(3)
+        ).transform(t)[0]
+        np.testing.assert_allclose(
+            np.asarray(out.column("o"))[0], [2, 4, 8, 3, 6, 12, 9, 18, 27], atol=1e-9
+        )
+
+    def test_invalid_degree(self):
+        with pytest.raises(ValueError):
+            PolynomialExpansion().set_degree(0)
+
+
+class TestInteraction:
+    def test_transform(self):
+        # InteractionTest EXPECTED_DENSE_OUTPUT
+        t = Table(
+            {
+                "f0": [1.0, 2.0],
+                "vec1": [Vectors.dense(1, 2), Vectors.dense(2, 8)],
+                "vec2": [Vectors.dense(3, 4), Vectors.dense(3, 4)],
+            }
+        )
+        out = (
+            Interaction().set_input_cols("f0", "vec1", "vec2").set_output_col("o")
+        ).transform(t)[0]
+        got = out.column("o")
+        np.testing.assert_allclose(np.asarray(got)[0], [3, 4, 6, 8], atol=1e-9)
+        np.testing.assert_allclose(np.asarray(got)[1], [12, 16, 48, 64], atol=1e-9)
+
+
+class TestDCT:
+    def test_forward(self):
+        t = Table({"vec": [Vectors.dense(1, 1, 1, 1), Vectors.dense(1, 0, -1, 0)]})
+        out = DCT().set_input_col("vec").set_output_col("o").transform(t)[0]
+        got = np.asarray(out.column("o"))
+        np.testing.assert_allclose(got[0], [2, 0, 0, 0], atol=1e-6)
+
+    def test_roundtrip(self):
+        x = np.random.RandomState(0).randn(5, 8)
+        t = Table({"vec": x})
+        fwd = DCT().set_input_col("vec").set_output_col("y").transform(t)[0]
+        back = (
+            DCT().set_input_col("y").set_output_col("z").set_inverse(True)
+        ).transform(fwd)[0]
+        np.testing.assert_allclose(np.asarray(back.column("z")), x, atol=1e-6)
+
+
+class TestVectorAssembler:
+    def test_transform(self):
+        t = Table({"f0": [1.0, 2.0], "vec": [Vectors.dense(2, 3), Vectors.dense(4, 5)]})
+        out = VectorAssembler().set_input_cols("f0", "vec").set_output_col("o").transform(t)[0]
+        np.testing.assert_array_equal(np.asarray(out.column("o")), [[1, 2, 3], [2, 4, 5]])
+
+    def test_handle_invalid(self):
+        t = Table({"f0": [1.0, float("nan")], "f1": [2.0, 3.0]})
+        op = VectorAssembler().set_input_cols("f0", "f1").set_output_col("o")
+        with pytest.raises(ValueError):
+            op.transform(t)
+        out = op.set_handle_invalid("skip").transform(t)[0]
+        assert out.num_rows == 1
+        out = op.set_handle_invalid("keep").transform(t)[0]
+        assert out.num_rows == 2
+
+    def test_input_sizes_mismatch(self):
+        t = Table({"vec": [Vectors.dense(1, 2)]})
+        op = VectorAssembler().set_input_cols("vec").set_output_col("o").set_input_sizes(3)
+        with pytest.raises(ValueError):
+            op.transform(t)
+
+
+class TestVectorSlicer:
+    def test_transform(self):
+        t = Table({"vec": [Vectors.dense(2.1, 3.1, 1.2, 3.1, 4.6)]})
+        out = VectorSlicer().set_input_col("vec").set_output_col("o").set_indices(0, 2, 4).transform(t)[0]
+        np.testing.assert_allclose(np.asarray(out.column("o")), [[2.1, 1.2, 4.6]])
+
+    def test_out_of_range(self):
+        t = Table({"vec": [Vectors.dense(1, 2)]})
+        with pytest.raises(ValueError):
+            VectorSlicer().set_input_col("vec").set_output_col("o").set_indices(5).transform(t)
+
+    def test_duplicate_indices_rejected(self):
+        with pytest.raises(ValueError):
+            VectorSlicer().set_indices(1, 1)
+
+
+class TestHashingTF:
+    # HashingTFTest.java INPUT / EXPECTED_OUTPUT
+    def _table(self):
+        return Table(
+            {
+                "input": [
+                    ["HashingTFTest", "Hashing", "Term", "Frequency", "Test"],
+                    ["HashingTFTest", "Hashing", "Hashing", "Test", "Test"],
+                ]
+            }
+        )
+
+    def test_transform(self):
+        out = HashingTF().transform(self._table())[0]
+        batch = out.column("output")
+        row0, row1 = batch.row(0), batch.row(1)
+        assert row0.size() == 262144
+        np.testing.assert_array_equal(
+            row0.indices, [67564, 89917, 113827, 131486, 228971]
+        )
+        np.testing.assert_array_equal(row0.values, [1, 1, 1, 1, 1])
+        np.testing.assert_array_equal(row1.indices, [67564, 131486, 228971])
+        np.testing.assert_array_equal(row1.values, [1, 2, 2])
+
+    def test_binary(self):
+        out = HashingTF().set_binary(True).transform(self._table())[0]
+        row1 = out.column("output").row(1)
+        np.testing.assert_array_equal(row1.values, [1, 1, 1])
+
+    def test_param_defaults(self):
+        tf = HashingTF()
+        assert tf.get_input_col() == "input"
+        assert tf.get_num_features() == 262144
+        assert not tf.get_binary()
+
+
+class TestTokenizers:
+    def test_tokenizer(self):
+        t = Table({"input": ["Test for tokenization.", "Te,st. punct"]})
+        out = Tokenizer().set_input_col("input").set_output_col("o").transform(t)[0]
+        got = list(out.column("o"))
+        assert got[0] == ["test", "for", "tokenization."]
+        assert got[1] == ["te,st.", "punct"]
+
+    def test_regex_tokenizer_gaps(self):
+        t = Table({"input": ["Test for tokenization.", "Te,st. punct"]})
+        out = (
+            RegexTokenizer().set_input_col("input").set_output_col("o").set_pattern(r"\w+").set_gaps(False)
+        ).transform(t)[0]
+        assert list(out.column("o"))[0] == ["test", "for", "tokenization"]
+
+    def test_regex_min_token_length(self):
+        t = Table({"input": ["a ab abc"]})
+        out = (
+            RegexTokenizer().set_input_col("input").set_output_col("o").set_min_token_length(2)
+        ).transform(t)[0]
+        assert list(out.column("o"))[0] == ["ab", "abc"]
+
+
+class TestNGram:
+    def test_transform(self):
+        t = Table({"input": [[], ["a", "b", "c"], ["a", "b", "c", "d"]]})
+        out = NGram().set_input_col("input").set_output_col("o").transform(t)[0]
+        got = list(out.column("o"))
+        assert got[0] == []
+        assert got[1] == ["a b", "b c"]
+        assert got[2] == ["a b", "b c", "c d"]
+
+    def test_n_larger_than_input(self):
+        t = Table({"input": [["a", "b"]]})
+        out = NGram().set_n(4).set_input_col("input").set_output_col("o").transform(t)[0]
+        assert list(out.column("o"))[0] == []
+
+
+class TestStopWordsRemover:
+    def test_transform(self):
+        t = Table({"raw": [["I", "saw", "the", "red", "balloon"], ["Mary", "had", "a", "little", "lamb"]]})
+        out = StopWordsRemover().set_input_cols("raw").set_output_cols("filtered").transform(t)[0]
+        got = list(out.column("filtered"))
+        assert got[0] == ["saw", "red", "balloon"]
+        assert got[1] == ["Mary", "little", "lamb"]
+
+    def test_case_sensitive(self):
+        t = Table({"raw": [["The", "the"]]})
+        op = (
+            StopWordsRemover()
+            .set_input_cols("raw")
+            .set_output_cols("o")
+            .set_case_sensitive(True)
+            .set_stop_words("the")
+        )
+        assert list(op.transform(t)[0].column("o"))[0] == ["The"]
+
+    def test_load_default_stop_words(self):
+        for lang in ["english", "french", "german", "spanish"]:
+            assert len(StopWordsRemover.load_default_stop_words(lang)) > 10
+        with pytest.raises(ValueError):
+            StopWordsRemover.load_default_stop_words("klingon")
+
+
+class TestRandomSplitter:
+    def test_split_fractions(self):
+        t = Table({"f": np.arange(10000, dtype=np.float64)})
+        parts = RandomSplitter().set_weights(4.0, 6.0).set_seed(0).transform(t)
+        assert len(parts) == 2
+        assert parts[0].num_rows + parts[1].num_rows == 10000
+        assert abs(parts[0].num_rows / 10000 - 0.4) < 0.02
+
+    def test_deterministic(self):
+        t = Table({"f": np.arange(100, dtype=np.float64)})
+        op = RandomSplitter().set_weights(1.0, 1.0).set_seed(42)
+        a = np.asarray(op.transform(t)[0].column("f"))
+        b = np.asarray(op.transform(t)[0].column("f"))
+        np.testing.assert_array_equal(a, b)
+
+    def test_invalid_weights(self):
+        with pytest.raises(ValueError):
+            RandomSplitter().set_weights(1.0)

@@ -1,0 +1,134 @@
+"""LinearRegression + LinearSVC batteries — mirror
+flink-ml-lib/src/test/java/org/apache/flink/ml/regression/LinearRegressionTest.java
+and .../classification/LinearSVCTest.java: params, fit+transform, save/load,
+get/set model data."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification.linearsvc import LinearSVC, LinearSVCModel
+from flink_ml_tpu.models.regression.linearregression import (
+    LinearRegression,
+    LinearRegressionModel,
+)
+from flink_ml_tpu.table import Table
+
+# LinearRegressionTest.java trainData: label = 1*f0 + 2*f1 + 3.
+REG_FEATURES = [
+    Vectors.dense(2, 1),
+    Vectors.dense(3, 2),
+    Vectors.dense(4, 3),
+    Vectors.dense(2, 4),
+    Vectors.dense(2, 2),
+    Vectors.dense(4, 3),
+    Vectors.dense(1, 2),
+    Vectors.dense(5, 3),
+]
+REG_LABELS = [4.0, 7.0, 10.0, 10.0, 6.0, 10.0, 5.0, 11.0]
+
+SVC_FEATURES = [
+    Vectors.dense(1, 2, 3, 4),
+    Vectors.dense(2, 2, 3, 4),
+    Vectors.dense(3, 2, 3, 4),
+    Vectors.dense(4, 2, 3, 4),
+    Vectors.dense(5, 2, 3, 4),
+    Vectors.dense(11, 2, 3, 4),
+    Vectors.dense(12, 2, 3, 4),
+    Vectors.dense(13, 2, 3, 4),
+    Vectors.dense(14, 2, 3, 4),
+    Vectors.dense(15, 2, 3, 4),
+]
+SVC_LABELS = [0.0, 0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+
+
+def _reg_table():
+    return Table({"features": REG_FEATURES, "label": REG_LABELS, "weight": [1.0] * 8})
+
+
+def _svc_table():
+    return Table({"features": SVC_FEATURES, "label": SVC_LABELS})
+
+
+class TestLinearRegression:
+    def test_param_defaults(self):
+        lr = LinearRegression()
+        assert lr.get_label_col() == "label"
+        assert lr.get_weight_col() is None
+        assert lr.get_max_iter() == 20
+        assert lr.get_reg() == 0.0
+        assert lr.get_elastic_net() == 0.0
+        assert lr.get_learning_rate() == 0.1
+        assert lr.get_global_batch_size() == 32
+        assert lr.get_tol() == 1e-6
+        assert lr.get_prediction_col() == "prediction"
+
+    def test_fit_and_predict(self):
+        lr = LinearRegression().set_weight_col("weight").set_max_iter(300).set_learning_rate(0.01)
+        model = lr.fit(_reg_table())
+        out = model.transform(_reg_table())[0]
+        pred = np.asarray(out.column("prediction"))
+        # The reference test allows loose tolerance (predictions near labels).
+        np.testing.assert_allclose(pred, REG_LABELS, rtol=0.3)
+
+    def test_save_load(self, tmp_path):
+        model = LinearRegression().set_max_iter(50).set_learning_rate(0.01).fit(_reg_table())
+        path = str(tmp_path / "linreg")
+        model.save(path)
+        loaded = LinearRegressionModel.load(path)
+        np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+        out1 = np.asarray(model.transform(_reg_table())[0].column("prediction"))
+        out2 = np.asarray(loaded.transform(_reg_table())[0].column("prediction"))
+        np.testing.assert_allclose(out1, out2)
+
+    def test_get_set_model_data(self):
+        model = LinearRegression().set_max_iter(20).set_learning_rate(0.01).fit(_reg_table())
+        other = LinearRegressionModel().set_model_data(model.get_model_data()[0])
+        np.testing.assert_allclose(other.coefficient, model.coefficient)
+
+    def test_distributed(self, mesh8):
+        model = LinearRegression().set_max_iter(20).set_learning_rate(0.01).fit(_reg_table())
+        assert model.coefficient.shape == (2,)
+        assert np.all(np.isfinite(model.coefficient))
+
+
+class TestLinearSVC:
+    def test_param_defaults(self):
+        svc = LinearSVC()
+        assert svc.get_threshold() == 0.0
+        assert svc.get_max_iter() == 20
+        assert svc.get_raw_prediction_col() == "rawPrediction"
+
+    def test_fit_and_predict(self):
+        model = LinearSVC().set_max_iter(100).fit(_svc_table())
+        out = model.transform(_svc_table())[0]
+        pred = np.asarray(out.column("prediction"))
+        np.testing.assert_array_equal(pred, SVC_LABELS)
+        raw = np.asarray(out.column("rawPrediction"))
+        assert raw.shape == (10, 2)
+        # rawPrediction = [dot, -dot] (LinearSVCModel.java:173)
+        np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-6)
+        assert np.all((raw[:, 0] >= 0.0) == (pred == 1.0))
+
+    def test_threshold(self):
+        model = LinearSVC().set_max_iter(100).fit(_svc_table())
+        model.set_threshold(1e9)
+        out = model.transform(_svc_table())[0]
+        np.testing.assert_array_equal(np.asarray(out.column("prediction")), np.zeros(10))
+
+    def test_rejects_non_binomial_labels(self):
+        t = Table({"features": SVC_FEATURES, "label": [float(i) for i in range(10)]})
+        with pytest.raises(ValueError):
+            LinearSVC().fit(t)
+
+    def test_save_load(self, tmp_path):
+        model = LinearSVC().set_max_iter(30).fit(_svc_table())
+        path = str(tmp_path / "svc")
+        model.save(path)
+        loaded = LinearSVCModel.load(path)
+        np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+
+    def test_get_set_model_data(self):
+        model = LinearSVC().set_max_iter(30).fit(_svc_table())
+        other = LinearSVCModel().set_model_data(model.get_model_data()[0])
+        np.testing.assert_allclose(other.coefficient, model.coefficient)
