@@ -364,3 +364,15 @@ class HasModelVersionCol(WithParams):
 
     def set_model_version_col(self, value: str):
         return self.set(self.MODEL_VERSION_COL, value)
+
+
+class HasMissingValue(WithParams):
+    MISSING_VALUE = FloatParam(
+        "missingValue", "The placeholder for the missing values.", float("nan")
+    )
+
+    def get_missing_value(self) -> float:
+        return self.get(self.MISSING_VALUE)
+
+    def set_missing_value(self, value: float):
+        return self.set(self.MISSING_VALUE, value)

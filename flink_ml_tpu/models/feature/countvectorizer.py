@@ -1,0 +1,154 @@
+"""CountVectorizer — learns a vocabulary and encodes token arrays as
+term-count sparse vectors.
+
+TPU-native re-design of feature/countvectorizer/CountVectorizer.java,
+CountVectorizerParams.java (vocabularySize default 2^18, minDF/maxDF as
+count >= 1 or fraction < 1) and CountVectorizerModelParams.java (minTF,
+binary). Vocabulary is ordered by descending corpus term frequency (ties
+broken alphabetically for determinism).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import BooleanParam, DoubleParam, IntParam, ParamValidators
+from ...table import Table, rows_to_sparse_batch
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class CountVectorizerModelParams(HasInputCol, HasOutputCol):
+    MIN_TF = DoubleParam(
+        "minTF",
+        "Filter to ignore rare words in a document: counts below the threshold "
+        "(absolute if >= 1, else fraction of the document's token count) are ignored.",
+        1.0,
+        ParamValidators.gt_eq(0.0),
+    )
+    BINARY = BooleanParam(
+        "binary", "Binary toggle to control the output vector values.", False
+    )
+
+    def get_min_tf(self) -> float:
+        return self.get(self.MIN_TF)
+
+    def set_min_tf(self, value: float):
+        return self.set(self.MIN_TF, value)
+
+    def get_binary(self) -> bool:
+        return self.get(self.BINARY)
+
+    def set_binary(self, value: bool):
+        return self.set(self.BINARY, value)
+
+
+class CountVectorizerParams(CountVectorizerModelParams):
+    VOCABULARY_SIZE = IntParam(
+        "vocabularySize",
+        "Max size of the vocabulary (top terms by corpus frequency).",
+        1 << 18,
+        ParamValidators.gt(0),
+    )
+    MIN_DF = DoubleParam(
+        "minDF",
+        "Minimum number (>= 1) or fraction (< 1) of documents a term must appear in.",
+        1.0,
+        ParamValidators.gt_eq(0.0),
+    )
+    MAX_DF = DoubleParam(
+        "maxDF",
+        "Maximum number (>= 1) or fraction (< 1) of documents a term may appear in.",
+        2**63 - 1.0,
+        ParamValidators.gt_eq(0.0),
+    )
+
+    def get_vocabulary_size(self) -> int:
+        return self.get(self.VOCABULARY_SIZE)
+
+    def set_vocabulary_size(self, value: int):
+        return self.set(self.VOCABULARY_SIZE, value)
+
+    def get_min_df(self) -> float:
+        return self.get(self.MIN_DF)
+
+    def set_min_df(self, value: float):
+        return self.set(self.MIN_DF, value)
+
+    def get_max_df(self) -> float:
+        return self.get(self.MAX_DF)
+
+    def set_max_df(self, value: float):
+        return self.set(self.MAX_DF, value)
+
+
+class CountVectorizerModel(Model, CountVectorizerModelParams):
+    def __init__(self):
+        self.vocabulary: List[str] = None
+
+    def set_model_data(self, *inputs: Table) -> "CountVectorizerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.vocabulary = list(row["vocabulary"])
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"vocabulary": [list(self.vocabulary)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        index = {t: i for i, t in enumerate(self.vocabulary)}
+        min_tf = self.get_min_tf()
+        binary = self.get_binary()
+        col = table.column(self.get_input_col())
+        size = len(self.vocabulary)
+        row_idx, row_val = [], []
+        for tokens in col:
+            tokens = list(tokens)
+            counts = Counter(t for t in tokens if t in index)
+            threshold = min_tf if min_tf >= 1.0 else min_tf * len(tokens)
+            kept = {index[t]: c for t, c in counts.items() if c >= threshold}
+            ordered = sorted(kept)
+            row_idx.append(ordered)
+            row_val.append([1.0 if binary else float(kept[i]) for i in ordered])
+        return [
+            table.with_column(
+                self.get_output_col(), rows_to_sparse_batch(size, row_idx, row_val)
+            )
+        ]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path, vocabulary=np.asarray(self.vocabulary, dtype=object)
+        )
+
+    def _load_extra(self, path: str) -> None:
+        self.vocabulary = [str(v) for v in read_write.load_model_arrays(path)["vocabulary"]]
+
+
+class CountVectorizer(Estimator, CountVectorizerParams):
+    def fit(self, *inputs: Table) -> CountVectorizerModel:
+        (table,) = inputs
+        col = table.column(self.get_input_col())
+        n_docs = len(col)
+        tf = Counter()
+        df = Counter()
+        for tokens in col:
+            tokens = list(tokens)
+            tf.update(tokens)
+            df.update(set(tokens))
+        min_df = self.get_min_df()
+        max_df = self.get_max_df()
+        min_count = min_df if min_df >= 1.0 else min_df * n_docs
+        max_count = max_df if max_df >= 1.0 else max_df * n_docs
+        terms = [t for t in tf if min_count <= df[t] <= max_count]
+        terms.sort(key=lambda t: (-tf[t], t))
+        model = CountVectorizerModel()
+        model.vocabulary = terms[: self.get_vocabulary_size()]
+        update_existing_params(model, self)
+        return model

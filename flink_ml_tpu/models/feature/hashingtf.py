@@ -17,7 +17,7 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCol, HasNumFeatures, HasOutputCol
 from ...param import BooleanParam
-from ...table import SparseBatch, Table
+from ...table import Table, rows_to_sparse_batch
 from ...utils.hashing import hash_term
 
 
@@ -39,27 +39,19 @@ class HashingTF(Transformer, HashingTFParams):
         col = table.column(self.get_input_col())
         n_features = self.get_num_features()
         binary = self.get_binary()
-        row_indices: List[np.ndarray] = []
-        row_values: List[np.ndarray] = []
-        max_nnz = 1
+        row_indices: List[List[int]] = []
+        row_values: List[List[float]] = []
         for terms in col:
             counts = {}
             for term in terms:
                 idx = hash_term(term) % n_features
                 counts[idx] = 1 if binary else counts.get(idx, 0) + 1
-            idx_arr = np.fromiter(sorted(counts), dtype=np.int32, count=len(counts))
-            val_arr = np.asarray([counts[i] for i in sorted(counts)], dtype=np.float64)
-            row_indices.append(idx_arr)
-            row_values.append(val_arr)
-            max_nnz = max(max_nnz, len(idx_arr))
-        n = len(row_indices)
-        indices = np.full((n, max_nnz), -1, dtype=np.int32)
-        values = np.zeros((n, max_nnz), dtype=np.float64)
-        for i, (ia, va) in enumerate(zip(row_indices, row_values)):
-            indices[i, : ia.size] = ia
-            values[i, : va.size] = va
+            ordered = sorted(counts)
+            row_indices.append(ordered)
+            row_values.append([float(counts[i]) for i in ordered])
         return [
             table.with_column(
-                self.get_output_col(), SparseBatch(n_features, indices, values)
+                self.get_output_col(),
+                rows_to_sparse_batch(n_features, row_indices, row_values),
             )
         ]

@@ -1,0 +1,127 @@
+"""Imputer — fills missing values with mean / median / most-frequent.
+
+TPU-native re-design of feature/imputer/Imputer.java (per-column surrogate
+computed while ignoring `missingValue` and NaN entries; MeanStrategy /
+MedianStrategy / MostFrequentStrategy aggregators) and ImputerModel.java.
+Median is an exact device quantile instead of a Greenwald-Khanna sketch
+(`relativeError` accepted for API parity).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import (
+    HasInputCols,
+    HasMissingValue,
+    HasOutputCols,
+    HasRelativeError,
+)
+from ...param import ParamValidators, StringParam
+from ...table import Table
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+MEAN = "mean"
+MEDIAN = "median"
+MOST_FREQUENT = "most_frequent"
+
+
+class ImputerModelParams(HasInputCols, HasOutputCols, HasMissingValue):
+    pass
+
+
+class ImputerParams(ImputerModelParams, HasRelativeError):
+    STRATEGY = StringParam(
+        "strategy",
+        "The imputation strategy.",
+        MEAN,
+        ParamValidators.in_array([MEAN, MEDIAN, MOST_FREQUENT]),
+    )
+
+    def get_strategy(self) -> str:
+        return self.get(self.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(self.STRATEGY, value)
+
+
+class ImputerModel(Model, ImputerModelParams):
+    def __init__(self):
+        self.surrogates: Dict[str, float] = None
+
+    def set_model_data(self, *inputs: Table) -> "ImputerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.surrogates = {
+            k: float(v) for k, v in zip(row["columnNames"], row["values"])
+        }
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        names = list(self.surrogates)
+        return [
+            Table(
+                {
+                    "columnNames": [names],
+                    "values": [DenseVector([self.surrogates[k] for k in names])],
+                }
+            )
+        ]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        missing = self.get_missing_value()
+        updates = {}
+        for name, out_name in zip(self.get_input_cols(), self.get_output_cols()):
+            arr = np.asarray(table.column(name), dtype=np.float64)
+            surrogate = self.surrogates[name]
+            # only the configured missing value is replaced at transform time
+            # (ImputerModel.java:159); fit-side NaNs are always excluded
+            mask = np.isnan(arr) if np.isnan(missing) else arr == missing
+            updates[out_name] = np.where(mask, surrogate, arr)
+        return [table.with_columns(updates)]
+
+    def _save_extra(self, path: str) -> None:
+        names = list(self.surrogates)
+        read_write.save_model_arrays(
+            path,
+            columnNames=np.asarray(names, dtype=object),
+            values=np.asarray([self.surrogates[k] for k in names]),
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.surrogates = {
+            str(k): float(v) for k, v in zip(arrays["columnNames"], arrays["values"])
+        }
+
+
+class Imputer(Estimator, ImputerParams):
+    def fit(self, *inputs: Table) -> ImputerModel:
+        (table,) = inputs
+        missing = self.get_missing_value()
+        strategy = self.get_strategy()
+        surrogates: Dict[str, float] = {}
+        for name in self.get_input_cols():
+            arr = np.asarray(table.column(name), dtype=np.float64)
+            mask = np.isnan(arr) if np.isnan(missing) else (arr == missing) | np.isnan(arr)
+            valid = arr[~mask]
+            if valid.size == 0:
+                raise ValueError(f"Column {name} has no valid values to impute from")
+            if strategy == MEAN:
+                surrogates[name] = float(valid.mean())
+            elif strategy == MEDIAN:
+                surrogates[name] = float(np.median(valid))
+            else:  # most_frequent: smallest among the most frequent values
+                values, counts = np.unique(valid, return_counts=True)
+                surrogates[name] = float(values[np.argmax(counts)])
+        model = ImputerModel()
+        model.surrogates = surrogates
+        update_existing_params(model, self)
+        return model

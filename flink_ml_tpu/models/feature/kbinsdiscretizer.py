@@ -1,0 +1,151 @@
+"""KBinsDiscretizer — bins continuous features by uniform / quantile /
+kmeans strategies.
+
+TPU-native re-design of feature/kbinsdiscretizer/KBinsDiscretizer.java:341
+(strategies UNIFORM / QUANTILE / KMEANS; `subSamples` caps the fit sample;
+model = per-feature bin edges; duplicate quantile edges collapse) and
+KBinsDiscretizerModel.java (searchsorted bucketing, values outside range
+clamp to the first/last bin). Quantiles/kmeans run as batched device ops.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import IntParam, ParamValidators, StringParam
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+UNIFORM = "uniform"
+QUANTILE = "quantile"
+KMEANS = "kmeans"
+
+
+class KBinsDiscretizerModelParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class KBinsDiscretizerParams(KBinsDiscretizerModelParams):
+    STRATEGY = StringParam(
+        "strategy",
+        "Strategy used to define the width of the bin.",
+        QUANTILE,
+        ParamValidators.in_array([UNIFORM, QUANTILE, KMEANS]),
+    )
+    NUM_BINS = IntParam("numBins", "Number of bins to produce.", 5, ParamValidators.gt_eq(2))
+    SUB_SAMPLES = IntParam(
+        "subSamples",
+        "Maximum number of samples used to fit the model.",
+        200000,
+        ParamValidators.gt_eq(2),
+    )
+
+    def get_strategy(self) -> str:
+        return self.get(self.STRATEGY)
+
+    def set_strategy(self, value: str):
+        return self.set(self.STRATEGY, value)
+
+    def get_num_bins(self) -> int:
+        return self.get(self.NUM_BINS)
+
+    def set_num_bins(self, value: int):
+        return self.set(self.NUM_BINS, value)
+
+    def get_sub_samples(self) -> int:
+        return self.get(self.SUB_SAMPLES)
+
+    def set_sub_samples(self, value: int):
+        return self.set(self.SUB_SAMPLES, value)
+
+
+def _kmeans_1d_edges(col: np.ndarray, num_bins: int) -> np.ndarray:
+    """1-D Lloyd on the column; edges are midpoints of sorted centroids
+    (KBinsDiscretizer.java KMEANS strategy)."""
+    uniq = np.unique(col)
+    k = min(num_bins, uniq.size)
+    centroids = np.quantile(col, np.linspace(0, 1, k))
+    centroids = np.unique(centroids)
+    for _ in range(100):
+        assign = np.argmin(np.abs(col[:, None] - centroids[None, :]), axis=1)
+        new_c = np.array(
+            [col[assign == j].mean() if np.any(assign == j) else centroids[j] for j in range(centroids.size)]
+        )
+        if np.allclose(new_c, centroids):
+            break
+        centroids = new_c
+    centroids = np.sort(centroids)
+    mids = (centroids[1:] + centroids[:-1]) / 2.0
+    return np.concatenate([[col.min()], mids, [col.max()]])
+
+
+class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
+    def __init__(self):
+        self.bin_edges: List[np.ndarray] = None  # per feature, increasing
+
+    def set_model_data(self, *inputs: Table) -> "KBinsDiscretizerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.bin_edges = [np.asarray(e, dtype=np.float64) for e in row["binEdges"]]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"binEdges": [[e.tolist() for e in self.bin_edges]]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col())).copy()
+        for j, edges in enumerate(self.bin_edges):
+            if edges.size <= 2:
+                X[:, j] = 0.0
+                continue
+            idx = np.searchsorted(edges, X[:, j], side="right") - 1
+            idx = np.clip(idx, 0, edges.size - 2)
+            X[:, j] = idx
+        return [table.with_column(self.get_output_col(), X)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path, binEdges=np.asarray([np.asarray(e) for e in self.bin_edges], dtype=object)
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.bin_edges = [np.asarray(e, dtype=np.float64) for e in arrays["binEdges"]]
+
+
+class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
+    def fit(self, *inputs: Table) -> KBinsDiscretizerModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        sub = self.get_sub_samples()
+        if X.shape[0] > sub:
+            rng = np.random.RandomState(0)
+            X = X[rng.choice(X.shape[0], size=sub, replace=False)]
+        strategy = self.get_strategy()
+        num_bins = self.get_num_bins()
+        edges_list: List[np.ndarray] = []
+        for j in range(X.shape[1]):
+            col = X[:, j]
+            if strategy == UNIFORM:
+                # unique collapses the constant-feature case to <= 2 edges,
+                # which transform maps to bin 0 (KBinsDiscretizer.java:63-64)
+                edges = np.unique(np.linspace(col.min(), col.max(), num_bins + 1))
+            elif strategy == QUANTILE:
+                qs = np.linspace(0.0, 1.0, num_bins + 1)
+                edges = np.asarray(jnp.quantile(jnp.asarray(col), jnp.asarray(qs)))
+                # collapse duplicate edges as the reference does
+                edges = np.unique(edges)
+            else:
+                edges = _kmeans_1d_edges(col, num_bins)
+            edges_list.append(np.asarray(edges, dtype=np.float64))
+        model = KBinsDiscretizerModel()
+        model.bin_edges = edges_list
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,218 @@
+"""MinHashLSH — locality-sensitive hashing for Jaccard distance.
+
+TPU-native re-design of feature/lsh/ (LSH.java, LSHModel.java:99-258,
+LSHModelData.java, MinHashLSH.java, MinHashLSHModelData.java): model data =
+random affine coefficients drawn with java.util.Random semantics
+(utils/javarandom.py) so reference-written models reproduce; hash =
+min(((1+index)*a + b) % PRIME) per function, grouped into
+numHashTables x numHashFunctionsPerTable; keyDistance = Jaccard distance;
+approxNearestNeighbors / approxSimilarityJoin prune by same-bucket
+candidates before exact distance, as the reference does. The min-hash
+evaluation is batched: one (n, numHashFunctions) device computation over
+the SparseBatch instead of a per-row double loop.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol, HasSeed
+from ...param import IntParam, ParamValidators
+from ...table import SparseBatch, Table, as_sparse_batch
+from ...utils import read_write
+from ...utils.javarandom import JavaRandom
+from ...utils.param_utils import update_existing_params
+
+HASH_PRIME = 2038074743  # MinHashLSHModelData.java HASH_PRIME
+
+
+class LSHParams(HasInputCol, HasOutputCol):
+    NUM_HASH_TABLES = IntParam(
+        "numHashTables", "Number of hash tables.", 1, ParamValidators.gt_eq(1)
+    )
+    NUM_HASH_FUNCTIONS_PER_TABLE = IntParam(
+        "numHashFunctionsPerTable",
+        "Number of hash functions per hash table.",
+        1,
+        ParamValidators.gt_eq(1),
+    )
+
+    def get_num_hash_tables(self) -> int:
+        return self.get(self.NUM_HASH_TABLES)
+
+    def set_num_hash_tables(self, value: int):
+        return self.set(self.NUM_HASH_TABLES, value)
+
+    def get_num_hash_functions_per_table(self) -> int:
+        return self.get(self.NUM_HASH_FUNCTIONS_PER_TABLE)
+
+    def set_num_hash_functions_per_table(self, value: int):
+        return self.set(self.NUM_HASH_FUNCTIONS_PER_TABLE, value)
+
+
+class MinHashLSHParams(LSHParams, HasSeed):
+    pass
+
+
+def _min_hash(indices: np.ndarray, coeff_a: np.ndarray, coeff_b: np.ndarray) -> np.ndarray:
+    """(n, k) padded indices (-1 = absent) -> (n, h) min-hash values.
+
+    Host-side int64 numpy: ((1+index)*a) needs 64-bit modular arithmetic
+    (a < 2^31, so the product overflows int32 — and jax without x64 would
+    silently truncate)."""
+    idx = indices.astype(np.int64)
+    valid = idx >= 0
+    vals = ((1 + idx[:, :, None]) * coeff_a[None, None, :] + coeff_b[None, None, :]) % HASH_PRIME
+    vals = np.where(valid[:, :, None], vals, HASH_PRIME)
+    return vals.min(axis=1).astype(np.float64)
+
+
+def _jaccard_distance(a_indices: np.ndarray, b_indices: np.ndarray) -> float:
+    a = set(int(i) for i in a_indices)
+    b = set(int(i) for i in b_indices)
+    union = len(a | b)
+    if union == 0:
+        raise ValueError("The union of two input sets must have at least 1 elements")
+    return 1.0 - len(a & b) / union
+
+
+class MinHashLSHModel(Model, LSHParams):
+    def __init__(self):
+        self.rand_coefficient_a: np.ndarray = None  # (numHashFunctions,)
+        self.rand_coefficient_b: np.ndarray = None
+
+    # -- model data ---------------------------------------------------------
+    def set_model_data(self, *inputs: Table) -> "MinHashLSHModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.rand_coefficient_a = np.asarray(row["randCoefficientA"], dtype=np.int64)
+        self.rand_coefficient_b = np.asarray(row["randCoefficientB"], dtype=np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [
+            Table(
+                {
+                    "randCoefficientA": [self.rand_coefficient_a.tolist()],
+                    "randCoefficientB": [self.rand_coefficient_b.tolist()],
+                }
+            )
+        ]
+
+    # -- hashing ------------------------------------------------------------
+    def _hash_batch(self, batch: SparseBatch) -> np.ndarray:
+        """(n, numHashTables, numHashFunctionsPerTable) hash values."""
+        h = _min_hash(
+            batch.indices, self.rand_coefficient_a, self.rand_coefficient_b
+        )
+        n = batch.n
+        return h.reshape(
+            n, self.get_num_hash_tables(), self.get_num_hash_functions_per_table()
+        )
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        batch = as_sparse_batch(table.column(self.get_input_col()))
+        if np.any((batch.indices >= 0).sum(axis=1) == 0):
+            raise ValueError("Must have at least 1 non zero entry.")
+        hashes = self._hash_batch(batch)
+        out = np.empty(batch.n, dtype=object)
+        for i in range(batch.n):
+            out[i] = [row.copy() for row in hashes[i]]
+        return [table.with_column(self.get_output_col(), out)]
+
+    # -- queries (LSHModel.java:137-258) ------------------------------------
+    def approx_nearest_neighbors(
+        self, dataset: Table, key, k: int, dist_col: str = "distCol"
+    ) -> Table:
+        batch = as_sparse_batch(dataset.column(self.get_input_col()))
+        hashes = self._hash_batch(batch).reshape(batch.n, -1)
+        key_sparse = key.to_sparse()
+        key_batch = SparseBatch(
+            batch.size, key_sparse.indices[None, :], key_sparse.values[None, :]
+        )
+        key_hash = self._hash_batch(key_batch).reshape(1, -1)
+        nt, nf = self.get_num_hash_tables(), self.get_num_hash_functions_per_table()
+        same = (
+            (hashes.reshape(-1, nt, nf) == key_hash.reshape(1, nt, nf))
+            .all(axis=2)
+            .any(axis=1)
+        )
+        candidates = np.nonzero(same)[0]
+        dists = []
+        for i in candidates:
+            mask = batch.indices[i] >= 0
+            dists.append(_jaccard_distance(batch.indices[i][mask], key_sparse.indices))
+        order = np.argsort(dists, kind="stable")[:k]
+        selected = candidates[order]
+        result = dataset.take(selected)
+        return result.with_column(dist_col, np.asarray(dists)[order])
+
+    def approx_similarity_join(
+        self, table_a: Table, table_b: Table, threshold: float, id_col: str,
+        dist_col: str = "distCol",
+    ) -> Table:
+        batch_a = as_sparse_batch(table_a.column(self.get_input_col()))
+        batch_b = as_sparse_batch(table_b.column(self.get_input_col()))
+        ha = self._hash_batch(batch_a)
+        hb = self._hash_batch(batch_b)
+        ids_a = table_a.column(id_col)
+        ids_b = table_b.column(id_col)
+        # bucket by (table idx, per-table hash tuple), join same buckets
+        pairs = set()
+        buckets = {}
+        for i in range(batch_a.n):
+            for t in range(ha.shape[1]):
+                buckets.setdefault((t, tuple(ha[i, t])), []).append(i)
+        for j in range(batch_b.n):
+            for t in range(hb.shape[1]):
+                for i in buckets.get((t, tuple(hb[j, t])), ()):
+                    pairs.add((i, j))
+        rows = []
+        for i, j in sorted(pairs):
+            mask_a = batch_a.indices[i] >= 0
+            mask_b = batch_b.indices[j] >= 0
+            d = _jaccard_distance(batch_a.indices[i][mask_a], batch_b.indices[j][mask_b])
+            if d <= threshold:
+                rows.append((ids_a[i], ids_b[j], d))
+        return Table(
+            {
+                f"{id_col}A": [r[0] for r in rows],
+                f"{id_col}B": [r[1] for r in rows],
+                dist_col: [r[2] for r in rows],
+            }
+        )
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path,
+            randCoefficientA=self.rand_coefficient_a,
+            randCoefficientB=self.rand_coefficient_b,
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.rand_coefficient_a = arrays["randCoefficientA"]
+        self.rand_coefficient_b = arrays["randCoefficientB"]
+
+
+class MinHashLSH(Estimator, MinHashLSHParams):
+    def fit(self, *inputs: Table) -> MinHashLSHModel:
+        (table,) = inputs
+        batch = as_sparse_batch(table.column(self.get_input_col()))
+        if batch.size > HASH_PRIME:
+            raise ValueError(
+                f"The input vector dimension {batch.size} exceeds the threshold {HASH_PRIME}."
+            )
+        num_fns = self.get_num_hash_tables() * self.get_num_hash_functions_per_table()
+        rng = JavaRandom(self.get_seed())
+        a = np.asarray([1 + rng.next_int(HASH_PRIME - 1) for _ in range(num_fns)], dtype=np.int64)
+        b = np.asarray([rng.next_int(HASH_PRIME - 1) for _ in range(num_fns)], dtype=np.int64)
+        model = MinHashLSHModel()
+        model.rand_coefficient_a = a
+        model.rand_coefficient_b = b
+        update_existing_params(model, self)
+        return model

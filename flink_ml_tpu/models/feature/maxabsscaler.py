@@ -1,0 +1,63 @@
+"""MaxAbsScaler — rescales features to [-1, 1] by max absolute value.
+
+TPU-native re-design of feature/maxabsscaler/MaxAbsScaler.java and
+MaxAbsScalerModel.java (divide by per-feature maxAbs; zero maxAbs leaves
+the feature unchanged). Fit is one jitted abs-max reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class MaxAbsScalerParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class MaxAbsScalerModel(Model, MaxAbsScalerParams):
+    def __init__(self):
+        self.max_abs: np.ndarray = None
+
+    def set_model_data(self, *inputs: Table) -> "MaxAbsScalerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.max_abs = np.asarray(row["maxVector"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [Table({"maxVector": [DenseVector(self.max_abs)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        scale = np.where(self.max_abs > 0, self.max_abs, 1.0)
+        return [table.with_column(self.get_output_col(), X / scale[None, :])]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, maxVector=self.max_abs)
+
+    def _load_extra(self, path: str) -> None:
+        self.max_abs = read_write.load_model_arrays(path)["maxVector"]
+
+
+class MaxAbsScaler(Estimator, MaxAbsScalerParams):
+    def fit(self, *inputs: Table) -> MaxAbsScalerModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        max_abs = jax.jit(lambda a: jnp.max(jnp.abs(a), axis=0))(jnp.asarray(X))
+        model = MaxAbsScalerModel()
+        model.max_abs = np.asarray(max_abs, dtype=np.float64)
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,105 @@
+"""MinMaxScaler — rescales features to a [min, max] output range.
+
+TPU-native re-design of feature/minmaxscaler/MinMaxScaler.java and
+MinMaxScalerModel.java (transform: scale = (max-min)/(eMax-eMin), constant
+features (|eMax-eMin| < 1e-5) map to the range midpoint). Fit is one jitted
+column min/max reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import DoubleParam, ParamValidators
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class MinMaxScalerParams(HasInputCol, HasOutputCol):
+    MIN = DoubleParam(
+        "min", "Lower bound of the output feature range.", 0.0, ParamValidators.not_null()
+    )
+    MAX = DoubleParam(
+        "max", "Upper bound of the output feature range.", 1.0, ParamValidators.not_null()
+    )
+
+    def get_min(self) -> float:
+        return self.get(self.MIN)
+
+    def set_min(self, value: float):
+        return self.set(self.MIN, value)
+
+    def get_max(self) -> float:
+        return self.get(self.MAX)
+
+    def set_max(self, value: float):
+        return self.set(self.MAX, value)
+
+
+class MinMaxScalerModel(Model, MinMaxScalerParams):
+    def __init__(self):
+        self.min_vector: np.ndarray = None
+        self.max_vector: np.ndarray = None
+
+    def set_model_data(self, *inputs: Table) -> "MinMaxScalerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.min_vector = np.asarray(row["minVector"].to_array(), dtype=np.float64)
+        self.max_vector = np.asarray(row["maxVector"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [
+            Table(
+                {
+                    "minVector": [DenseVector(self.min_vector)],
+                    "maxVector": [DenseVector(self.max_vector)],
+                }
+            )
+        ]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        lo, hi = self.get_min(), self.get_max()
+        span = self.max_vector - self.min_vector
+        constant = np.abs(span) < 1.0e-5
+        scale = np.where(constant, 0.0, (hi - lo) / np.where(constant, 1.0, span))
+        offset = np.where(constant, (hi + lo) / 2.0, lo - self.min_vector * scale)
+        out = X * scale[None, :] + offset[None, :]
+        return [table.with_column(self.get_output_col(), out)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path, minVector=self.min_vector, maxVector=self.max_vector
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.min_vector, self.max_vector = arrays["minVector"], arrays["maxVector"]
+
+
+@jax.jit
+def _column_min_max(X):
+    return jnp.min(X, axis=0), jnp.max(X, axis=0)
+
+
+class MinMaxScaler(Estimator, MinMaxScalerParams):
+    def fit(self, *inputs: Table) -> MinMaxScalerModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        mn, mx = _column_min_max(jnp.asarray(X))
+        model = MinMaxScalerModel()
+        model.min_vector = np.asarray(mn, dtype=np.float64)
+        model.max_vector = np.asarray(mx, dtype=np.float64)
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,114 @@
+"""OneHotEncoder — encodes index columns as one-hot sparse vectors.
+
+TPU-native re-design of feature/onehotencoder/OneHotEncoder.java:246 and
+OneHotEncoderModel.java (`dropLast` default true: stored vector size =
+numCategories - 1 and the last category encodes as the empty vector;
+handleInvalid error/keep). Output is a SparseBatch per encoded column.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasHandleInvalid, HasInputCols, HasOutputCols
+from ...param import BooleanParam
+from ...table import SparseBatch, Table
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class OneHotEncoderModelParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    DROP_LAST = BooleanParam("dropLast", "Whether to drop the last category.", True)
+
+    def get_drop_last(self) -> bool:
+        return self.get(self.DROP_LAST)
+
+    def set_drop_last(self, value: bool):
+        return self.set(self.DROP_LAST, value)
+
+
+class OneHotEncoderParams(OneHotEncoderModelParams):
+    pass
+
+
+class OneHotEncoderModel(Model, OneHotEncoderModelParams):
+    def __init__(self):
+        self.category_sizes: np.ndarray = None  # per-column max index + 1
+
+    def set_model_data(self, *inputs: Table) -> "OneHotEncoderModel":
+        (model_data,) = inputs
+        rows = model_data.collect()
+        sizes = {}
+        for row in rows:
+            sizes[int(row["columnIndex"])] = int(row["categorySize"])
+        self.category_sizes = np.asarray(
+            [sizes[i] for i in range(len(sizes))], dtype=np.int64
+        )
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [
+            Table(
+                {
+                    "columnIndex": np.arange(len(self.category_sizes)),
+                    "categorySize": np.asarray(self.category_sizes),
+                }
+            )
+        ]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        drop = 1 if self.get_drop_last() else 0
+        handle = self.get_handle_invalid()
+        updates = {}
+        drop_mask = np.zeros(table.num_rows, dtype=bool)
+        for i, (name, out_name) in enumerate(
+            zip(self.get_input_cols(), self.get_output_cols())
+        ):
+            vec_size = int(self.category_sizes[i]) - drop
+            idx = np.asarray(table.column(name), dtype=np.float64)
+            int_idx = idx.astype(np.int64)
+            if np.any(int_idx != idx) or np.any(int_idx < 0):
+                raise ValueError(f"Value cannot be parsed as indexed integer in column {name}")
+            invalid = int_idx > vec_size if drop else int_idx >= vec_size
+            if invalid.any():
+                if handle == HasHandleInvalid.ERROR_INVALID:
+                    raise ValueError(
+                        f"The input contains invalid index in column {name}. See "
+                        "handleInvalid parameter for more options."
+                    )
+                if handle == HasHandleInvalid.SKIP_INVALID:
+                    drop_mask |= invalid
+            # index == vec_size (the dropped last category) -> empty vector.
+            indices = np.where(int_idx < vec_size, int_idx, -1).astype(np.int32)[:, None]
+            values = np.where(indices >= 0, 1.0, 0.0)
+            updates[out_name] = SparseBatch(vec_size, indices, values)
+        result = table.with_columns(updates)
+        if drop_mask.any():
+            result = result.take(np.nonzero(~drop_mask)[0])
+        return [result]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, categorySizes=self.category_sizes)
+
+    def _load_extra(self, path: str) -> None:
+        self.category_sizes = read_write.load_model_arrays(path)["categorySizes"]
+
+
+class OneHotEncoder(Estimator, OneHotEncoderParams):
+    def fit(self, *inputs: Table) -> OneHotEncoderModel:
+        (table,) = inputs
+        sizes = []
+        for name in self.get_input_cols():
+            idx = np.asarray(table.column(name), dtype=np.float64)
+            int_idx = idx.astype(np.int64)
+            if np.any(int_idx != idx) or np.any(int_idx < 0):
+                raise ValueError(f"Value cannot be parsed as indexed integer in column {name}")
+            sizes.append(int(int_idx.max()) + 1)
+        model = OneHotEncoderModel()
+        model.category_sizes = np.asarray(sizes, dtype=np.int64)
+        update_existing_params(model, self)
+        return model

@@ -67,6 +67,10 @@ class RegexTokenizer(Transformer, RegexTokenizerParams):
         out = np.empty(len(col), dtype=object)
         for i, s in enumerate(col):
             text = str(s).lower() if lower else str(s)
-            tokens = pattern.split(text) if gaps else pattern.findall(text)
+            if gaps:
+                tokens = pattern.split(text)
+            else:
+                # full matches, not capture groups (RegexTokenizer.java matcher.group())
+                tokens = [m.group(0) for m in pattern.finditer(text)]
             out[i] = [t for t in tokens if len(t) >= min_len]
         return [table.with_column(self.get_output_col(), out)]

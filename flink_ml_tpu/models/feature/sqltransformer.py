@@ -1,0 +1,99 @@
+"""SQLTransformer — applies a SQL statement with __THIS__ as the input table.
+
+TPU-native re-design of feature/sqltransformer/SQLTransformer.java:193 (the
+reference executes `SELECT ... FROM __THIS__` through the Flink Table API).
+Without a streaming SQL engine, scalar columns are evaluated through an
+in-memory sqlite3 database (stdlib), which covers the SELECT / WHERE /
+GROUP BY / aggregate subset the reference's docs demonstrate. Vector and
+array columns pass through only when selected verbatim via `*`.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...param import ParamValidators, StringParam
+from ...table import Table
+
+
+class SQLTransformer(Transformer):
+    STATEMENT = StringParam(
+        "statement", "SQL statement.", None, ParamValidators.not_null()
+    )
+
+    def get_statement(self) -> str:
+        return self.get(self.STATEMENT)
+
+    def set_statement(self, value: str):
+        if "__THIS__" not in value:
+            raise ValueError("Parameter statement must contain '__THIS__'")
+        return self.set(self.STATEMENT, value)
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        statement = self.get_statement()
+        if statement is None:
+            raise ValueError("Parameter statement must be set")
+        sql = re.sub(r"__THIS__", "__this__", statement)
+        conn = sqlite3.connect(":memory:")
+        try:
+            scalar_cols = []
+            for name in table.column_names:
+                col = table.column(name)
+                arr = np.asarray(col) if not hasattr(col, "indices") else None
+                if arr is not None and arr.ndim == 1 and arr.dtype != object:
+                    scalar_cols.append(name)
+                elif arr is not None and arr.dtype == object and all(
+                    isinstance(v, (str, int, float, type(None))) for v in arr
+                ):
+                    scalar_cols.append(name)
+            if not scalar_cols:
+                raise ValueError("SQLTransformer requires at least one scalar column")
+            quoted = ", ".join(f'"{c}"' for c in scalar_cols)
+            conn.execute(f"CREATE TABLE __this__ ({quoted})")
+            rows = list(
+                zip(*[np.asarray(table.column(c)).tolist() for c in scalar_cols])
+            )
+            conn.executemany(
+                f"INSERT INTO __this__ ({quoted}) VALUES ({', '.join('?' * len(scalar_cols))})",
+                rows,
+            )
+            # Track surviving row identities so non-scalar (vector) columns can
+            # pass through a `SELECT *`; falls back cleanly when the statement
+            # aggregates (rowid is then invalid in the select list).
+            row_ids = None
+            names, data = None, None
+            m = re.match(r"(?is)^\s*select\s+", sql)
+            if m is not None:
+                with_rid = sql[: m.end()] + "rowid AS __rid__, " + sql[m.end():]
+                try:
+                    cursor = conn.execute(with_rid)
+                    names = [d[0] for d in cursor.description]
+                    data = cursor.fetchall()
+                    rid_pos = names.index("__rid__")
+                    row_ids = [row[rid_pos] - 1 for row in data]
+                    names = [n for n in names if n != "__rid__"]
+                    data = [
+                        tuple(v for i, v in enumerate(row) if i != rid_pos)
+                        for row in data
+                    ]
+                except sqlite3.Error:
+                    row_ids = None
+            if row_ids is None:
+                cursor = conn.execute(sql)
+                names = [d[0] for d in cursor.description]
+                data = cursor.fetchall()
+        finally:
+            conn.close()
+        columns = {name: [row[i] for row in data] for i, name in enumerate(names)}
+        out = Table(columns)
+        non_scalar = [c for c in table.column_names if c not in scalar_cols]
+        if row_ids is not None and non_scalar:
+            passthrough = table.take(np.asarray(row_ids, dtype=np.int64))
+            out = out.with_columns({c: passthrough.column(c) for c in non_scalar})
+        return [out]

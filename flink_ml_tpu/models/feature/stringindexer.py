@@ -1,0 +1,195 @@
+"""StringIndexer / IndexToStringModel — string <-> index encoding.
+
+TPU-native re-design of feature/stringindexer/StringIndexer.java,
+StringIndexerModel.java (per-column string->double index maps, handleInvalid
+error/skip/keep with unseen -> len(strings)), StringIndexerParams.java
+(stringOrderType: arbitrary | frequencyDesc | frequencyAsc | alphabetDesc |
+alphabetAsc) and IndexToStringModel.java (reverse mapping). Numeric input
+values are indexed via their string form, as in the reference.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasHandleInvalid, HasInputCols, HasOutputCols
+from ...param import ParamValidators, StringParam
+from ...table import Table
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+ARBITRARY_ORDER = "arbitrary"
+FREQUENCY_DESC_ORDER = "frequencyDesc"
+FREQUENCY_ASC_ORDER = "frequencyAsc"
+ALPHABET_DESC_ORDER = "alphabetDesc"
+ALPHABET_ASC_ORDER = "alphabetAsc"
+
+
+def _to_string(value) -> str:
+    if isinstance(value, str):
+        return value
+    if isinstance(value, (float, np.floating)):
+        return repr(float(value))
+    return str(value)
+
+
+class StringIndexerModelParams(HasInputCols, HasOutputCols, HasHandleInvalid):
+    pass
+
+
+class StringIndexerParams(StringIndexerModelParams):
+    STRING_ORDER_TYPE = StringParam(
+        "stringOrderType",
+        "How to order strings of each column.",
+        ARBITRARY_ORDER,
+        ParamValidators.in_array(
+            [
+                ARBITRARY_ORDER,
+                FREQUENCY_DESC_ORDER,
+                FREQUENCY_ASC_ORDER,
+                ALPHABET_DESC_ORDER,
+                ALPHABET_ASC_ORDER,
+            ]
+        ),
+    )
+
+    def get_string_order_type(self) -> str:
+        return self.get(self.STRING_ORDER_TYPE)
+
+    def set_string_order_type(self, value: str):
+        return self.set(self.STRING_ORDER_TYPE, value)
+
+
+class StringIndexerModel(Model, StringIndexerModelParams):
+    def __init__(self):
+        self.string_arrays: List[List[str]] = None
+
+    def set_model_data(self, *inputs: Table) -> "StringIndexerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.string_arrays = [list(arr) for arr in row["stringArrays"]]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"stringArrays": [[list(a) for a in self.string_arrays]]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        handle = self.get_handle_invalid()
+        updates = {}
+        drop_mask = np.zeros(table.num_rows, dtype=bool)
+        for strings, name, out_name in zip(
+            self.string_arrays, self.get_input_cols(), self.get_output_cols()
+        ):
+            mapping = {s: float(i) for i, s in enumerate(strings)}
+            unseen = float(len(strings))
+            col = table.column(name)
+            out = np.empty(len(col), dtype=np.float64)
+            for i, v in enumerate(col):
+                key = _to_string(v)
+                if key in mapping:
+                    out[i] = mapping[key]
+                elif handle == HasHandleInvalid.KEEP_INVALID:
+                    out[i] = unseen
+                elif handle == HasHandleInvalid.SKIP_INVALID:
+                    out[i] = np.nan
+                    drop_mask[i] = True
+                else:
+                    raise ValueError(
+                        f"The input contains unseen string: {key}. See "
+                        "handleInvalid parameter for more options."
+                    )
+            updates[out_name] = out
+        result = table.with_columns(updates)
+        if drop_mask.any():
+            result = result.take(np.nonzero(~drop_mask)[0])
+        return [result]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path,
+            stringArrays=np.asarray(
+                [np.asarray(a, dtype=object) for a in self.string_arrays], dtype=object
+            ),
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.string_arrays = [list(a) for a in arrays["stringArrays"]]
+
+
+class IndexToStringModelParams(HasInputCols, HasOutputCols):
+    pass
+
+
+class IndexToStringModel(Model, IndexToStringModelParams):
+    """Reverse transform: index -> original string (IndexToStringModel.java)."""
+
+    def __init__(self):
+        self.string_arrays: List[List[str]] = None
+
+    def set_model_data(self, *inputs: Table) -> "IndexToStringModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.string_arrays = [list(arr) for arr in row["stringArrays"]]
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"stringArrays": [[list(a) for a in self.string_arrays]]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        updates = {}
+        for strings, name, out_name in zip(
+            self.string_arrays, self.get_input_cols(), self.get_output_cols()
+        ):
+            col = table.column(name)
+            out = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col):
+                idx = int(v)
+                if idx < 0 or idx >= len(strings):
+                    raise ValueError(
+                        f"The input contains unseen index: {idx}."
+                    )
+                out[i] = strings[idx]
+            updates[out_name] = out
+        return [table.with_columns(updates)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path,
+            stringArrays=np.asarray(
+                [np.asarray(a, dtype=object) for a in self.string_arrays], dtype=object
+            ),
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.string_arrays = [list(a) for a in arrays["stringArrays"]]
+
+
+class StringIndexer(Estimator, StringIndexerParams):
+    def fit(self, *inputs: Table) -> StringIndexerModel:
+        (table,) = inputs
+        order = self.get_string_order_type()
+        string_arrays: List[List[str]] = []
+        for name in self.get_input_cols():
+            col = table.column(name)
+            counts = Counter(_to_string(v) for v in col)
+            if order in (ARBITRARY_ORDER, ALPHABET_ASC_ORDER):
+                strings = sorted(counts)
+            elif order == ALPHABET_DESC_ORDER:
+                strings = sorted(counts, reverse=True)
+            elif order == FREQUENCY_DESC_ORDER:
+                strings = [s for s, _ in counts.most_common()]
+            else:  # frequencyAsc
+                strings = [s for s, _ in sorted(counts.items(), key=lambda kv: (kv[1], kv[0]))]
+            string_arrays.append(strings)
+        model = StringIndexerModel()
+        model.string_arrays = string_arrays
+        update_existing_params(model, self)
+        return model

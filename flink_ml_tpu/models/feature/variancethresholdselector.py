@@ -1,0 +1,86 @@
+"""VarianceThresholdSelector — removes low-variance features.
+
+TPU-native re-design of feature/variancethresholdselector/
+VarianceThresholdSelector.java and VarianceThresholdSelectorModel.java
+(features with sample variance <= varianceThreshold are dropped; model =
+kept indices). Fit is one jitted variance reduction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasInputCol, HasOutputCol
+from ...param import DoubleParam, ParamValidators
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class VarianceThresholdSelectorModelParams(HasInputCol, HasOutputCol):
+    pass
+
+
+class VarianceThresholdSelectorParams(VarianceThresholdSelectorModelParams):
+    VARIANCE_THRESHOLD = DoubleParam(
+        "varianceThreshold",
+        "Features with a variance not greater than this threshold will be removed.",
+        0.0,
+        ParamValidators.gt_eq(0.0),
+    )
+
+    def get_variance_threshold(self) -> float:
+        return self.get(self.VARIANCE_THRESHOLD)
+
+    def set_variance_threshold(self, value: float):
+        return self.set(self.VARIANCE_THRESHOLD, value)
+
+
+class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams):
+    def __init__(self):
+        self.indices: np.ndarray = None  # kept feature indices
+
+    def set_model_data(self, *inputs: Table) -> "VarianceThresholdSelectorModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.indices = np.asarray(row["indices"], dtype=np.int64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"indices": [self.indices.tolist()]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        if self.indices.size > 0 and self.indices.max() >= X.shape[1]:
+            raise ValueError("Model feature count does not match input vector size")
+        return [table.with_column(self.get_output_col(), X[:, self.indices])]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, indices=self.indices)
+
+    def _load_extra(self, path: str) -> None:
+        self.indices = read_write.load_model_arrays(path)["indices"]
+
+
+@jax.jit
+def _sample_variance(X):
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    return jnp.sum((X - mean) ** 2, axis=0) / jnp.maximum(n - 1, 1)
+
+
+class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
+    def fit(self, *inputs: Table) -> VarianceThresholdSelectorModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        var = np.asarray(_sample_variance(jnp.asarray(X)))
+        model = VarianceThresholdSelectorModel()
+        model.indices = np.nonzero(var > self.get_variance_threshold())[0]
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,130 @@
+"""VectorIndexer — indexes categorical features inside vectors.
+
+TPU-native re-design of feature/vectorindexer/VectorIndexer.java and
+VectorIndexerModel.java (features with <= maxCategories distinct values get
+a value->index map; values sorted ascending except 0 always maps to index
+of 0's sorted slot moved to front — VectorIndexer.java's map builder;
+handleInvalid error/skip/keep with unseen -> len(map)).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasHandleInvalid, HasInputCol, HasOutputCol
+from ...param import IntParam, ParamValidators
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class VectorIndexerModelParams(HasInputCol, HasOutputCol, HasHandleInvalid):
+    pass
+
+
+class VectorIndexerParams(VectorIndexerModelParams):
+    MAX_CATEGORIES = IntParam(
+        "maxCategories",
+        "Threshold for the number of values a categorical feature can take. If a "
+        "feature is found to have > maxCategories values, then it is declared continuous.",
+        20,
+        ParamValidators.gt(1),
+    )
+
+    def get_max_categories(self) -> int:
+        return self.get(self.MAX_CATEGORIES)
+
+    def set_max_categories(self, value: int):
+        return self.set(self.MAX_CATEGORIES, value)
+
+
+def _build_category_map(values: np.ndarray) -> Dict[float, int]:
+    """Sorted ascending, with 0.0 hoisted to the front if present
+    (VectorIndexer.java model builder)."""
+    vals = np.sort(np.unique(values))
+    vals = list(vals)
+    if 0.0 in vals:
+        vals.remove(0.0)
+        vals.insert(0, 0.0)
+    return {float(v): i for i, v in enumerate(vals)}
+
+
+class VectorIndexerModel(Model, VectorIndexerModelParams):
+    def __init__(self):
+        self.category_maps: Dict[int, Dict[float, int]] = None
+
+    def set_model_data(self, *inputs: Table) -> "VectorIndexerModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.category_maps = {
+            int(k): {float(a): int(b) for a, b in v.items()}
+            for k, v in row["categoryMaps"].items()
+        }
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"categoryMaps": [dict(self.category_maps)]})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        handle = self.get_handle_invalid()
+        X = as_dense_matrix(table.column(self.get_input_col())).copy()
+        drop_mask = np.zeros(X.shape[0], dtype=bool)
+        for col_id, mapping in self.category_maps.items():
+            col = X[:, col_id]
+            out = np.empty_like(col)
+            for i, v in enumerate(col):
+                key = float(v)
+                if key in mapping:
+                    out[i] = mapping[key]
+                elif handle == HasHandleInvalid.KEEP_INVALID:
+                    out[i] = len(mapping)
+                elif handle == HasHandleInvalid.SKIP_INVALID:
+                    drop_mask[i] = True
+                else:
+                    raise ValueError(
+                        f"The input contains unseen value: {key}. See "
+                        "handleInvalid parameter for more options."
+                    )
+            X[:, col_id] = out
+        result = table.with_column(self.get_output_col(), X)
+        if drop_mask.any():
+            result = result.take(np.nonzero(~drop_mask)[0])
+        return [result]
+
+    def _save_extra(self, path: str) -> None:
+        cols = sorted(self.category_maps)
+        read_write.save_model_arrays(
+            path,
+            columns=np.asarray(cols, dtype=np.int64),
+            keys=np.asarray(
+                [np.asarray(sorted(self.category_maps[c], key=self.category_maps[c].get)) for c in cols],
+                dtype=object,
+            ),
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.category_maps = {
+            int(c): {float(v): i for i, v in enumerate(keys)}
+            for c, keys in zip(arrays["columns"], arrays["keys"])
+        }
+
+
+class VectorIndexer(Estimator, VectorIndexerParams):
+    def fit(self, *inputs: Table) -> VectorIndexerModel:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_input_col()))
+        max_cat = self.get_max_categories()
+        category_maps = {}
+        for j in range(X.shape[1]):
+            distinct = np.unique(X[:, j])
+            if distinct.size <= max_cat:
+                category_maps[j] = _build_category_map(X[:, j])
+        model = VectorIndexerModel()
+        model.category_maps = category_maps
+        update_existing_params(model, self)
+        return model

@@ -274,6 +274,18 @@ def as_dense_matrix(col) -> np.ndarray:
     return arr
 
 
+def rows_to_sparse_batch(size: int, row_indices, row_values) -> SparseBatch:
+    """Assemble per-row (indices, values) pairs into a padded SparseBatch."""
+    n = len(row_indices)
+    max_nnz = max((len(ia) for ia in row_indices), default=0) or 1
+    indices = np.full((n, max_nnz), -1, dtype=np.int32)
+    values = np.zeros((n, max_nnz), dtype=np.float64)
+    for i, (ia, va) in enumerate(zip(row_indices, row_values)):
+        indices[i, : len(ia)] = ia
+        values[i, : len(va)] = va
+    return SparseBatch(size, indices, values)
+
+
 def as_sparse_batch(col, size: Optional[int] = None) -> SparseBatch:
     """Coerce a features column to a SparseBatch."""
     if isinstance(col, SparseBatch):

@@ -1,0 +1,477 @@
+"""Feature-estimator battery — mirrors the reference tests under
+flink-ml-lib/src/test/java/org/apache/flink/ml/feature/ (MinMaxScalerTest,
+MaxAbsScalerTest, RobustScalerTest, ImputerTest, StringIndexerTest,
+IndexToStringModelTest, OneHotEncoderTest, VectorIndexerTest,
+CountVectorizerTest, IDFTest, KBinsDiscretizerTest,
+VarianceThresholdSelectorTest, UnivariateFeatureSelectorTest,
+MinHashLSHTest, SQLTransformerTest)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.models.feature.countvectorizer import CountVectorizer, CountVectorizerModel
+from flink_ml_tpu.models.feature.idf import IDF, IDFModel
+from flink_ml_tpu.models.feature.imputer import Imputer, ImputerModel
+from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer, KBinsDiscretizerModel
+from flink_ml_tpu.models.feature.lsh import MinHashLSH, MinHashLSHModel
+from flink_ml_tpu.models.feature.maxabsscaler import MaxAbsScaler, MaxAbsScalerModel
+from flink_ml_tpu.models.feature.minmaxscaler import MinMaxScaler, MinMaxScalerModel
+from flink_ml_tpu.models.feature.onehotencoder import OneHotEncoder, OneHotEncoderModel
+from flink_ml_tpu.models.feature.robustscaler import RobustScaler, RobustScalerModel
+from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
+from flink_ml_tpu.models.feature.stringindexer import (
+    IndexToStringModel,
+    StringIndexer,
+    StringIndexerModel,
+)
+from flink_ml_tpu.models.feature.univariatefeatureselector import UnivariateFeatureSelector
+from flink_ml_tpu.models.feature.variancethresholdselector import VarianceThresholdSelector
+from flink_ml_tpu.models.feature.vectorindexer import VectorIndexer, VectorIndexerModel
+
+
+class TestMinMaxScaler:
+    def test_fit_transform(self):
+        train = Table({"input": [Vectors.dense(0, 3), Vectors.dense(2.1, 0), Vectors.dense(4.1, 5.1)]})
+        model = MinMaxScaler().fit(train)
+        out = model.transform(Table({"input": [Vectors.dense(4.1, 5.1), Vectors.dense(0, 3)]}))[0]
+        got = np.asarray(out.column("output"))
+        np.testing.assert_allclose(got[0], [1.0, 1.0], atol=1e-7)
+        np.testing.assert_allclose(got[1], [0.0, 3 / 5.1], atol=1e-7)
+
+    def test_output_range(self):
+        train = Table({"input": [Vectors.dense(0.0), Vectors.dense(10.0)]})
+        model = MinMaxScaler().set_min(-1.0).set_max(1.0).fit(train)
+        got = np.asarray(model.transform(Table({"input": [Vectors.dense(5.0)]}))[0].column("output"))
+        np.testing.assert_allclose(got, [[0.0]], atol=1e-7)
+
+    def test_constant_feature_maps_to_midpoint(self):
+        train = Table({"input": [Vectors.dense(3.0), Vectors.dense(3.0)]})
+        model = MinMaxScaler().fit(train)
+        got = np.asarray(model.transform(train)[0].column("output"))
+        np.testing.assert_allclose(got, [[0.5], [0.5]])
+
+    def test_save_load(self, tmp_path):
+        train = Table({"input": [Vectors.dense(0.0, 1.0), Vectors.dense(2.0, 3.0)]})
+        model = MinMaxScaler().fit(train)
+        model.save(str(tmp_path / "mms"))
+        loaded = MinMaxScalerModel.load(str(tmp_path / "mms"))
+        np.testing.assert_allclose(loaded.min_vector, model.min_vector)
+        other = MinMaxScalerModel().set_model_data(model.get_model_data()[0])
+        np.testing.assert_allclose(other.max_vector, model.max_vector)
+
+
+class TestMaxAbsScaler:
+    def test_fit_transform(self):
+        train = Table({"input": [Vectors.dense(2, -8), Vectors.dense(-4, 4)]})
+        model = MaxAbsScaler().fit(train)
+        got = np.asarray(model.transform(train)[0].column("output"))
+        np.testing.assert_allclose(got, [[0.5, -1.0], [-1.0, 0.5]])
+
+    def test_save_load(self, tmp_path):
+        train = Table({"input": [Vectors.dense(2, -8)]})
+        model = MaxAbsScaler().fit(train)
+        model.save(str(tmp_path / "mas"))
+        loaded = MaxAbsScalerModel.load(str(tmp_path / "mas"))
+        np.testing.assert_allclose(loaded.max_abs, [2, 8])
+
+
+class TestRobustScaler:
+    def test_fit_transform(self):
+        X = np.arange(1, 9, dtype=np.float64)[:, None]  # 1..8, q25=2.75, q75=6.25
+        model = RobustScaler().fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        np.testing.assert_allclose(out[:, 0], X[:, 0] / (model.ranges[0]), atol=1e-7)
+
+    def test_centering(self):
+        X = np.arange(1, 10, dtype=np.float64)[:, None]
+        model = RobustScaler().set_with_centering(True).fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        assert abs(out[4, 0]) < 1e-7  # median row maps to 0
+
+    def test_save_load(self, tmp_path):
+        X = np.arange(8, dtype=np.float64)[:, None]
+        model = RobustScaler().fit(Table({"input": X}))
+        model.save(str(tmp_path / "rs"))
+        loaded = RobustScalerModel.load(str(tmp_path / "rs"))
+        np.testing.assert_allclose(loaded.medians, model.medians)
+
+
+class TestImputer:
+    def _table(self):
+        return Table(
+            {
+                "f1": [1.0, 4.0, float("nan"), 7.0],
+                "f2": [2.0, float("nan"), 6.0, 10.0],
+            }
+        )
+
+    def _op(self):
+        return Imputer().set_input_cols("f1", "f2").set_output_cols("o1", "o2")
+
+    def test_mean(self):
+        model = self._op().fit(self._table())
+        out = model.transform(self._table())[0]
+        np.testing.assert_allclose(np.asarray(out.column("o1")), [1, 4, 4, 7])
+        np.testing.assert_allclose(np.asarray(out.column("o2")), [2, 6, 6, 10])
+
+    def test_median(self):
+        model = self._op().set_strategy("median").fit(self._table())
+        out = model.transform(self._table())[0]
+        np.testing.assert_allclose(np.asarray(out.column("o1")), [1, 4, 4, 7])
+
+    def test_most_frequent(self):
+        t = Table({"f1": [1.0, 1.0, 2.0, float("nan")], "f2": [3.0, 3.0, 3.0, 4.0]})
+        model = Imputer().set_input_cols("f1", "f2").set_output_cols("o1", "o2").set_strategy("most_frequent").fit(t)
+        out = model.transform(t)[0]
+        np.testing.assert_allclose(np.asarray(out.column("o1")), [1, 1, 2, 1])
+
+    def test_custom_missing_value(self):
+        t = Table({"f1": [1.0, -1.0, 3.0]})
+        model = (
+            Imputer().set_input_cols("f1").set_output_cols("o1").set_missing_value(-1.0)
+        ).fit(t)
+        out = model.transform(t)[0]
+        np.testing.assert_allclose(np.asarray(out.column("o1")), [1, 2, 3])
+
+    def test_save_load(self, tmp_path):
+        model = self._op().fit(self._table())
+        model.save(str(tmp_path / "imp"))
+        loaded = ImputerModel.load(str(tmp_path / "imp"))
+        assert loaded.surrogates == model.surrogates
+
+
+class TestStringIndexer:
+    def _table(self):
+        return Table({"f1": ["a", "b", "b", "c"], "f2": [2.0, 1.0, 1.0, 3.0]})
+
+    def test_alphabet_asc(self):
+        model = (
+            StringIndexer()
+            .set_input_cols("f1", "f2")
+            .set_output_cols("o1", "o2")
+            .set_string_order_type("alphabetAsc")
+        ).fit(self._table())
+        out = model.transform(self._table())[0]
+        np.testing.assert_array_equal(np.asarray(out.column("o1")), [0, 1, 1, 2])
+
+    def test_frequency_desc(self):
+        model = (
+            StringIndexer()
+            .set_input_cols("f1")
+            .set_output_cols("o1")
+            .set_string_order_type("frequencyDesc")
+        ).fit(self._table())
+        out = model.transform(self._table())[0]
+        got = np.asarray(out.column("o1"))
+        assert got[1] == 0 and got[2] == 0  # "b" is most frequent
+
+    def test_handle_invalid(self):
+        model = (
+            StringIndexer().set_input_cols("f1").set_output_cols("o1").set_string_order_type("alphabetAsc")
+        ).fit(self._table())
+        unseen = Table({"f1": ["a", "z"]})
+        with pytest.raises(ValueError):
+            model.transform(unseen)
+        got = np.asarray(model.set_handle_invalid("keep").transform(unseen)[0].column("o1"))
+        np.testing.assert_array_equal(got, [0, 3])
+        out = model.set_handle_invalid("skip").transform(unseen)[0]
+        assert out.num_rows == 1
+
+    def test_index_to_string(self):
+        model = (
+            StringIndexer().set_input_cols("f1").set_output_cols("o1").set_string_order_type("alphabetAsc")
+        ).fit(self._table())
+        reverse = IndexToStringModel().set_input_cols("idx").set_output_cols("str")
+        reverse.set_model_data(*model.get_model_data())
+        out = reverse.transform(Table({"idx": [0.0, 2.0]}))[0]
+        assert list(out.column("str")) == ["a", "c"]
+
+    def test_save_load(self, tmp_path):
+        model = (
+            StringIndexer().set_input_cols("f1").set_output_cols("o1").set_string_order_type("alphabetAsc")
+        ).fit(self._table())
+        model.save(str(tmp_path / "si"))
+        loaded = StringIndexerModel.load(str(tmp_path / "si"))
+        assert loaded.string_arrays == model.string_arrays
+
+
+class TestOneHotEncoder:
+    def test_fit_transform(self):
+        train = Table({"input": [0.0, 1.0, 2.0, 0.0]})
+        model = OneHotEncoder().set_input_cols("input").set_output_cols("output").fit(train)
+        out = model.transform(train)[0]
+        batch = out.column("output")
+        assert batch.size == 2  # dropLast: 3 categories -> size 2
+        np.testing.assert_array_equal(batch.to_dense(), [[1, 0], [0, 1], [0, 0], [1, 0]])
+
+    def test_no_drop_last(self):
+        train = Table({"input": [0.0, 1.0]})
+        model = (
+            OneHotEncoder().set_input_cols("input").set_output_cols("output").set_drop_last(False)
+        ).fit(train)
+        batch = model.transform(train)[0].column("output")
+        np.testing.assert_array_equal(batch.to_dense(), [[1, 0], [0, 1]])
+
+    def test_save_load(self, tmp_path):
+        train = Table({"input": [0.0, 1.0, 2.0]})
+        model = OneHotEncoder().set_input_cols("input").set_output_cols("output").fit(train)
+        model.save(str(tmp_path / "ohe"))
+        loaded = OneHotEncoderModel.load(str(tmp_path / "ohe"))
+        np.testing.assert_array_equal(loaded.category_sizes, model.category_sizes)
+
+
+class TestVectorIndexer:
+    def test_fit_transform(self):
+        train = Table(
+            {"input": [Vectors.dense(1, 11), Vectors.dense(2, 12), Vectors.dense(1, 13), Vectors.dense(2, 14)]}
+        )
+        model = VectorIndexer().set_max_categories(3).fit(train)
+        # column 0 has 2 distinct -> categorical {1->0, 2->1}; column 1 has 4 -> continuous
+        out = model.transform(train)[0]
+        got = np.asarray(out.column("output"))
+        np.testing.assert_array_equal(got[:, 0], [0, 1, 0, 1])
+        np.testing.assert_array_equal(got[:, 1], [11, 12, 13, 14])
+
+    def test_zero_first(self):
+        train = Table({"input": [Vectors.dense(3.0), Vectors.dense(0.0), Vectors.dense(-1.0)]})
+        model = VectorIndexer().set_max_categories(5).fit(train)
+        assert model.category_maps[0][0.0] == 0
+
+    def test_handle_invalid(self):
+        train = Table({"input": [Vectors.dense(1.0), Vectors.dense(2.0)]})
+        model = VectorIndexer().set_max_categories(5).fit(train)
+        unseen = Table({"input": [Vectors.dense(9.0)]})
+        with pytest.raises(ValueError):
+            model.transform(unseen)
+        got = np.asarray(model.set_handle_invalid("keep").transform(unseen)[0].column("output"))
+        np.testing.assert_array_equal(got, [[2.0]])
+
+    def test_save_load(self, tmp_path):
+        train = Table({"input": [Vectors.dense(1.0), Vectors.dense(2.0)]})
+        model = VectorIndexer().fit(train)
+        model.save(str(tmp_path / "vi"))
+        loaded = VectorIndexerModel.load(str(tmp_path / "vi"))
+        assert loaded.category_maps == model.category_maps
+
+
+class TestCountVectorizer:
+    def test_fit_transform(self):
+        t = Table({"input": [["a", "b", "c"], ["a", "b", "b", "c", "a"]]})
+        model = CountVectorizer().fit(t)
+        assert model.vocabulary[0] in ("a", "b")  # both appear 3x; ties alphabetic -> "a"
+        out = model.transform(t)[0].column("output")
+        dense = out.to_dense()
+        assert dense.shape == (2, 3)
+        # row 1: a=2, b=2, c=1
+        vocab_idx = {v: i for i, v in enumerate(model.vocabulary)}
+        assert dense[1, vocab_idx["a"]] == 2
+        assert dense[1, vocab_idx["b"]] == 2
+        assert dense[1, vocab_idx["c"]] == 1
+
+    def test_min_tf(self):
+        t = Table({"input": [["a", "a", "b"]]})
+        model = CountVectorizer().set_min_tf(2.0).fit(t)
+        dense = model.transform(t)[0].column("output").to_dense()
+        vocab_idx = {v: i for i, v in enumerate(model.vocabulary)}
+        assert dense[0, vocab_idx["a"]] == 2 and dense[0, vocab_idx["b"]] == 0
+
+    def test_save_load(self, tmp_path):
+        t = Table({"input": [["x", "y"]]})
+        model = CountVectorizer().fit(t)
+        model.save(str(tmp_path / "cv"))
+        loaded = CountVectorizerModel.load(str(tmp_path / "cv"))
+        assert loaded.vocabulary == model.vocabulary
+
+
+class TestIDF:
+    def test_fit_transform(self):
+        # IDFTest.java-style data: df over 3 docs
+        t = Table(
+            {"input": [Vectors.dense(1, 2, 0), Vectors.dense(1, 0, 3), Vectors.dense(1, 4, 5)]}
+        )
+        model = IDF().fit(t)
+        expected_idf = np.log(np.array([4 / 4, 4 / 3, 4 / 3]))
+        np.testing.assert_allclose(model.idf, expected_idf, atol=1e-7)
+        out = np.asarray(model.transform(t)[0].column("output"))
+        np.testing.assert_allclose(out[0], [0.0, 2 * expected_idf[1], 0.0], atol=1e-7)
+
+    def test_min_doc_freq(self):
+        t = Table(
+            {"input": [Vectors.dense(1, 0), Vectors.dense(1, 2), Vectors.dense(0, 0)]}
+        )
+        model = IDF().set_min_doc_freq(2).fit(t)
+        # feature 1 (df=1 < 2) filtered to 0; feature 0 (df=2) keeps log(4/3)
+        assert model.idf[1] == 0.0
+        np.testing.assert_allclose(model.idf[0], np.log(4 / 3), atol=1e-7)
+
+    def test_save_load(self, tmp_path):
+        t = Table({"input": [Vectors.dense(1, 0)]})
+        model = IDF().fit(t)
+        model.save(str(tmp_path / "idf"))
+        loaded = IDFModel.load(str(tmp_path / "idf"))
+        np.testing.assert_allclose(loaded.idf, model.idf)
+        assert loaded.num_docs == 1
+
+
+class TestKBinsDiscretizer:
+    def test_uniform(self):
+        X = np.asarray([[0.0], [1.0], [2.0], [10.0]])
+        model = KBinsDiscretizer().set_strategy("uniform").set_num_bins(5).fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        np.testing.assert_array_equal(out[:, 0], [0, 0, 1, 4])
+
+    def test_quantile(self):
+        X = np.arange(100, dtype=np.float64)[:, None]
+        model = KBinsDiscretizer().set_num_bins(4).fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        counts = np.bincount(out[:, 0].astype(int))
+        assert len(counts) == 4 and all(20 <= c <= 30 for c in counts)
+
+    def test_kmeans(self):
+        X = np.concatenate([np.zeros(50), np.ones(50) * 10])[:, None]
+        model = KBinsDiscretizer().set_strategy("kmeans").set_num_bins(2).fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        assert set(out[:50, 0]) == {0.0} and set(out[50:, 0]) == {1.0}
+
+    def test_out_of_range_clamps(self):
+        X = np.asarray([[0.0], [1.0]])
+        model = KBinsDiscretizer().set_strategy("uniform").set_num_bins(2).fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": [[-5.0], [99.0]]}))[0].column("output"))
+        np.testing.assert_array_equal(out[:, 0], [0, 1])
+
+    def test_save_load(self, tmp_path):
+        X = np.arange(10, dtype=np.float64)[:, None]
+        model = KBinsDiscretizer().fit(Table({"input": X}))
+        model.save(str(tmp_path / "kb"))
+        loaded = KBinsDiscretizerModel.load(str(tmp_path / "kb"))
+        np.testing.assert_allclose(loaded.bin_edges[0], model.bin_edges[0])
+
+
+class TestVarianceThresholdSelector:
+    def test_fit_transform(self):
+        X = np.asarray([[1.0, 5.0, 0.0], [2.0, 5.0, 0.0], [3.0, 5.0, 0.0]])
+        model = VarianceThresholdSelector().fit(Table({"input": X}))
+        out = np.asarray(model.transform(Table({"input": X}))[0].column("output"))
+        np.testing.assert_array_equal(model.indices, [0])
+        np.testing.assert_array_equal(out, [[1], [2], [3]])
+
+    def test_threshold(self):
+        X = np.asarray([[0.0, 0.0], [1.0, 10.0]])
+        model = VarianceThresholdSelector().set_variance_threshold(1.0).fit(Table({"input": X}))
+        np.testing.assert_array_equal(model.indices, [1])
+
+
+class TestUnivariateFeatureSelector:
+    def test_anova_num_top(self):
+        rng = np.random.RandomState(0)
+        y = np.repeat([0.0, 1.0], 50)
+        X = rng.randn(100, 4)
+        X[:, 2] += y * 5  # only feature 2 is informative
+        t = Table({"features": X, "label": y})
+        model = (
+            UnivariateFeatureSelector()
+            .set_feature_type("continuous")
+            .set_label_type("categorical")
+            .set_selection_threshold(1)
+        ).fit(t)
+        np.testing.assert_array_equal(model.indices, [2])
+        out = np.asarray(model.transform(t)[0].column("output"))
+        np.testing.assert_allclose(out[:, 0], X[:, 2])
+
+    def test_fpr_chisq(self):
+        rng = np.random.RandomState(1)
+        y = np.repeat([0.0, 1.0], 100)
+        X = rng.randint(0, 3, size=(200, 3)).astype(float)
+        X[:, 0] = y  # perfectly dependent
+        t = Table({"features": X, "label": y})
+        model = (
+            UnivariateFeatureSelector()
+            .set_feature_type("categorical")
+            .set_label_type("categorical")
+            .set_selection_mode("fpr")
+            .set_selection_threshold(0.01)
+        ).fit(t)
+        assert 0 in model.indices
+
+    def test_requires_types(self):
+        with pytest.raises(ValueError):
+            UnivariateFeatureSelector().fit(Table({"features": [[1.0]], "label": [1.0]}))
+
+
+class TestMinHashLSH:
+    def _table(self):
+        return Table(
+            {
+                "id": [0, 1, 2],
+                "vec": [
+                    Vectors.sparse(6, [0, 1, 2], [1.0, 1.0, 1.0]),
+                    Vectors.sparse(6, [2, 3, 4], [1.0, 1.0, 1.0]),
+                    Vectors.sparse(6, [0, 2, 4], [1.0, 1.0, 1.0]),
+                ],
+            }
+        )
+
+    def _model(self):
+        return (
+            MinHashLSH()
+            .set_input_col("vec")
+            .set_output_col("hashes")
+            .set_num_hash_tables(5)
+            .set_seed(2022)
+        ).fit(self._table())
+
+    def test_transform_shape(self):
+        model = self._model()
+        out = model.transform(self._table())[0]
+        hashes = list(out.column("hashes"))
+        assert len(hashes) == 3 and len(hashes[0]) == 5
+
+    def test_deterministic_model(self):
+        a1 = self._model().rand_coefficient_a
+        a2 = self._model().rand_coefficient_a
+        np.testing.assert_array_equal(a1, a2)
+
+    def test_nearest_neighbors(self):
+        model = self._model()
+        result = model.approx_nearest_neighbors(
+            self._table(), Vectors.sparse(6, [0, 1, 2], [1.0, 1.0, 1.0]), 2
+        )
+        ids = list(result.column("id"))
+        assert ids[0] == 0  # exact match first
+        dists = np.asarray(result.column("distCol"))
+        assert dists[0] == 0.0
+
+    def test_similarity_join(self):
+        model = self._model()
+        joined = model.approx_similarity_join(self._table(), self._table(), 0.9, "id")
+        pairs = set(zip(joined.column("idA"), joined.column("idB")))
+        assert (0, 0) in pairs
+
+    def test_save_load(self, tmp_path):
+        model = self._model()
+        model.save(str(tmp_path / "lsh"))
+        loaded = MinHashLSHModel.load(str(tmp_path / "lsh"))
+        np.testing.assert_array_equal(loaded.rand_coefficient_a, model.rand_coefficient_a)
+
+
+class TestSQLTransformer:
+    def test_select(self):
+        t = Table({"id": [1, 2], "v1": [1.0, 2.0], "v2": [3.0, 4.0]})
+        out = (
+            SQLTransformer().set_statement("SELECT *, (v1 + v2) AS v3 FROM __THIS__")
+        ).transform(t)[0]
+        np.testing.assert_allclose(np.asarray(out.column("v3")), [4.0, 6.0])
+
+    def test_aggregate(self):
+        t = Table({"g": [1, 1, 2], "v": [1.0, 3.0, 10.0]})
+        out = (
+            SQLTransformer().set_statement("SELECT g, SUM(v) AS s FROM __THIS__ GROUP BY g")
+        ).transform(t)[0]
+        assert out.num_rows == 2
+        np.testing.assert_allclose(sorted(np.asarray(out.column("s"))), [4.0, 10.0])
+
+    def test_requires_this(self):
+        with pytest.raises(ValueError):
+            SQLTransformer().set_statement("SELECT 1")
