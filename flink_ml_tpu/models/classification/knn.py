@@ -1,0 +1,100 @@
+"""Knn — k-nearest-neighbors classification by brute force.
+
+TPU-native re-design of classification/knn/Knn.java (model = the cached
+training matrix + labels) and KnnModel.java (per-row distance scan +
+top-k majority vote). The per-row scan becomes ONE pairwise-distance
+matmul (n_test, n_train) on the MXU plus a lax.top_k — the layout the
+hardware wants.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasFeaturesCol, HasLabelCol, HasPredictionCol
+from ...param import IntParam, ParamValidators
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class KnnModelParams(HasFeaturesCol, HasPredictionCol):
+    K = IntParam("k", "The number of nearest neighbors.", 5, ParamValidators.gt(0))
+
+    def get_k(self) -> int:
+        return self.get(self.K)
+
+    def set_k(self, value: int):
+        return self.set(self.K, value)
+
+
+class KnnParams(KnnModelParams, HasLabelCol):
+    pass
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _top_k_labels(X_test, X_train, y_train, k):
+    """Squared-euclidean pairwise distances -> top-k neighbor labels."""
+    t2 = jnp.sum(X_test * X_test, axis=1, keepdims=True)
+    r2 = jnp.sum(X_train * X_train, axis=1)[None, :]
+    dists = t2 - 2.0 * (X_test @ X_train.T) + r2
+    _, idx = jax.lax.top_k(-dists, k)  # (n_test, k)
+    return y_train[idx]
+
+
+class KnnModel(Model, KnnModelParams):
+    def __init__(self):
+        self.features: np.ndarray = None  # (n_train, d)
+        self.labels: np.ndarray = None  # (n_train,)
+
+    def set_model_data(self, *inputs: Table) -> "KnnModel":
+        (model_data,) = inputs
+        self.features = as_dense_matrix(model_data.column("features"))
+        self.labels = np.asarray(model_data.column("labels"), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        return [Table({"features": self.features, "labels": self.labels})]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        k = min(self.get_k(), self.features.shape[0])
+        neighbor_labels = np.asarray(
+            _top_k_labels(
+                jnp.asarray(X, jnp.float32),
+                jnp.asarray(self.features, jnp.float32),
+                jnp.asarray(self.labels, jnp.float32),
+                k,
+            ),
+            dtype=np.float64,
+        )
+        # majority vote per row (KnnModel.java voting)
+        pred = np.empty(X.shape[0], dtype=np.float64)
+        for i, row in enumerate(neighbor_labels):
+            values, counts = np.unique(row, return_counts=True)
+            pred[i] = values[np.argmax(counts)]
+        return [table.with_column(self.get_prediction_col(), pred)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(path, features=self.features, labels=self.labels)
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.features, self.labels = arrays["features"], arrays["labels"]
+
+
+class Knn(Estimator, KnnParams):
+    def fit(self, *inputs: Table) -> KnnModel:
+        (table,) = inputs
+        model = KnnModel()
+        model.features = as_dense_matrix(table.column(self.get_features_col()))
+        model.labels = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        update_existing_params(model, self)
+        return model

@@ -1,0 +1,162 @@
+"""NaiveBayes — multinomial naive Bayes over categorical feature values.
+
+TPU-native re-design of classification/naivebayes/NaiveBayes.java
+(GenerateModelFunction smoothing math matched exactly:
+theta[i][j][v] = log(count(label i, feature j = v) + smoothing)
+              - log(count(label i) + smoothing * numCategories[j]);
+pi[i] = log(count(label i) * featureSize + smoothing)
+      - log(totalDocs * featureSize + numLabels * smoothing)),
+NaiveBayesModel.java calculateProb (sum of per-feature log-probs + pi,
+argmax by label) and NaiveBayesModelData.java:57-69. Unseen feature values
+at predict time raise, as the reference's map lookup does.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import numpy as np
+
+from ...api import Estimator, Model
+from ...common.param import HasFeaturesCol, HasLabelCol, HasPredictionCol
+from ...param import DoubleParam, ParamValidators, StringParam
+from ...table import Table, as_dense_matrix
+from ...utils import read_write
+from ...utils.param_utils import update_existing_params
+
+
+class NaiveBayesModelParams(HasFeaturesCol, HasPredictionCol):
+    MODEL_TYPE = StringParam(
+        "modelType",
+        "The model type.",
+        "multinomial",
+        ParamValidators.in_array(["multinomial"]),
+    )
+
+    def get_model_type(self) -> str:
+        return self.get(self.MODEL_TYPE)
+
+    def set_model_type(self, value: str):
+        return self.set(self.MODEL_TYPE, value)
+
+
+class NaiveBayesParams(NaiveBayesModelParams, HasLabelCol):
+    SMOOTHING = DoubleParam(
+        "smoothing", "The smoothing parameter.", 1.0, ParamValidators.gt_eq(0.0)
+    )
+
+    def get_smoothing(self) -> float:
+        return self.get(self.SMOOTHING)
+
+    def set_smoothing(self, value: float):
+        return self.set(self.SMOOTHING, value)
+
+
+class NaiveBayesModel(Model, NaiveBayesModelParams):
+    def __init__(self):
+        self.theta: List[List[Dict[float, float]]] = None  # [label][feature] -> {value: logp}
+        self.pi: np.ndarray = None  # (numLabels,) log priors
+        self.labels: np.ndarray = None  # (numLabels,) label values
+
+    def set_model_data(self, *inputs: Table) -> "NaiveBayesModel":
+        (model_data,) = inputs
+        row = model_data.collect()[0]
+        self.theta = row["theta"]
+        self.pi = np.asarray(row["piArray"].to_array(), dtype=np.float64)
+        self.labels = np.asarray(row["labels"].to_array(), dtype=np.float64)
+        return self
+
+    def get_model_data(self) -> List[Table]:
+        from ...linalg import DenseVector
+
+        return [
+            Table(
+                {
+                    "theta": [self.theta],
+                    "piArray": [DenseVector(self.pi)],
+                    "labels": [DenseVector(self.labels)],
+                }
+            )
+        ]
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        n, d = X.shape
+        num_labels = len(self.labels)
+        probs = np.tile(self.pi, (n, 1))  # (n, numLabels)
+        for j in range(d):
+            # vectorized map lookup per feature: build value -> per-label logp
+            mapping = {}
+            for i in range(num_labels):
+                for v, logp in self.theta[i][j].items():
+                    mapping.setdefault(v, np.full(num_labels, np.nan))[i] = logp
+            col = X[:, j]
+            for r in range(n):
+                v = float(col[r])
+                if v not in mapping:
+                    raise ValueError(
+                        f"Feature value {v} in column {j} was not seen during training"
+                    )
+                probs[r] += mapping[v]
+        pred = self.labels[np.argmax(probs, axis=1)]
+        return [table.with_column(self.get_prediction_col(), pred)]
+
+    def _save_extra(self, path: str) -> None:
+        read_write.save_model_arrays(
+            path,
+            theta=np.asarray(self.theta, dtype=object),
+            piArray=self.pi,
+            labels=self.labels,
+        )
+
+    def _load_extra(self, path: str) -> None:
+        arrays = read_write.load_model_arrays(path)
+        self.theta = [list(row) for row in arrays["theta"]]
+        self.pi = arrays["piArray"]
+        self.labels = arrays["labels"]
+
+
+class NaiveBayes(Estimator, NaiveBayesParams):
+    def fit(self, *inputs: Table) -> NaiveBayesModel:
+        (table,) = inputs
+        smoothing = self.get_smoothing()
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        if np.isnan(y).any():
+            raise ValueError("Label column contains null/NaN values")
+        n, d = X.shape
+        labels = np.unique(y)
+        num_labels = len(labels)
+        label_counts = {float(l): int(np.sum(y == l)) for l in labels}
+        # per-feature category sets across ALL labels
+        categories = [np.unique(X[:, j]) for j in range(d)]
+        theta: List[List[Dict[float, float]]] = []
+        for l in labels:
+            rows = X[y == l]
+            label_theta = []
+            for j in range(d):
+                values, counts = np.unique(rows[:, j], return_counts=True)
+                count_map = dict(zip(values, counts))
+                theta_log = math.log(label_counts[float(l)] + smoothing * len(categories[j]))
+                label_theta.append(
+                    {
+                        float(v): math.log(count_map.get(v, 0.0) + smoothing) - theta_log
+                        for v in categories[j]
+                    }
+                )
+            theta.append(label_theta)
+        pi_log = math.log(n * d + num_labels * smoothing)
+        pi = np.asarray(
+            [
+                math.log(label_counts[float(l)] * d + smoothing) - pi_log
+                for l in labels
+            ]
+        )
+        model = NaiveBayesModel()
+        model.theta = theta
+        model.pi = pi
+        model.labels = labels.astype(np.float64)
+        update_existing_params(model, self)
+        return model

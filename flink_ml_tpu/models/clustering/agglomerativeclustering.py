@@ -1,0 +1,176 @@
+"""AgglomerativeClustering — hierarchical clustering with 4 linkages.
+
+TPU-native re-design of clustering/agglomerativeclustering/
+AgglomerativeClustering.java (nearest-neighbor-chain agglomeration; linkage
+ward/complete/single/average via Lance-Williams updates; stop at
+numClusters OR distanceThreshold; computeFullTree continues merging for
+the merge-info side output; ward requires euclidean). Outputs two tables:
+the input plus the prediction column, and the merge log
+(clusterId1, clusterId2, distance, sizeOfMergedCluster).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import AlgoOperator
+from ...common.param import (
+    HasDistanceMeasure,
+    HasFeaturesCol,
+    HasPredictionCol,
+    HasWindows,
+)
+from ...ops.distance import DistanceMeasure
+from ...param import BooleanParam, DoubleParam, IntParam, ParamValidators, StringParam
+from ...table import Table, as_dense_matrix
+
+LINKAGE_WARD = "ward"
+LINKAGE_COMPLETE = "complete"
+LINKAGE_SINGLE = "single"
+LINKAGE_AVERAGE = "average"
+
+
+class AgglomerativeClusteringParams(
+    HasDistanceMeasure, HasFeaturesCol, HasPredictionCol, HasWindows
+):
+    NUM_CLUSTERS = IntParam("numClusters", "The max number of clusters to create.", 2)
+    DISTANCE_THRESHOLD = DoubleParam(
+        "distanceThreshold",
+        "Threshold to decide whether two clusters should be merged.",
+        None,
+    )
+    LINKAGE = StringParam(
+        "linkage",
+        "Criterion for computing distance between two clusters.",
+        LINKAGE_WARD,
+        ParamValidators.in_array(
+            [LINKAGE_WARD, LINKAGE_COMPLETE, LINKAGE_AVERAGE, LINKAGE_SINGLE]
+        ),
+    )
+    COMPUTE_FULL_TREE = BooleanParam(
+        "computeFullTree",
+        "Whether computes the full tree after convergence.",
+        False,
+        ParamValidators.not_null(),
+    )
+
+    def get_num_clusters(self):
+        return self.get(self.NUM_CLUSTERS)
+
+    def set_num_clusters(self, value):
+        return self.set(self.NUM_CLUSTERS, value)
+
+    def get_distance_threshold(self):
+        return self.get(self.DISTANCE_THRESHOLD)
+
+    def set_distance_threshold(self, value):
+        return self.set(self.DISTANCE_THRESHOLD, value)
+
+    def get_linkage(self) -> str:
+        return self.get(self.LINKAGE)
+
+    def set_linkage(self, value: str):
+        return self.set(self.LINKAGE, value)
+
+    def get_compute_full_tree(self) -> bool:
+        return self.get(self.COMPUTE_FULL_TREE)
+
+    def set_compute_full_tree(self, value: bool):
+        return self.set(self.COMPUTE_FULL_TREE, value)
+
+
+def _lance_williams_update(d_ik, d_jk, d_ij, size_i, size_j, size_k, linkage):
+    """Distance of merged cluster (i+j) to every other cluster k."""
+    if linkage == LINKAGE_SINGLE:
+        return np.minimum(d_ik, d_jk)
+    if linkage == LINKAGE_COMPLETE:
+        return np.maximum(d_ik, d_jk)
+    if linkage == LINKAGE_AVERAGE:
+        return (size_i * d_ik + size_j * d_jk) / (size_i + size_j)
+    # ward (on euclidean distances)
+    total = size_i + size_j + size_k
+    return np.sqrt(
+        ((size_i + size_k) * d_ik**2 + (size_j + size_k) * d_jk**2 - size_k * d_ij**2)
+        / total
+    )
+
+
+class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        linkage = self.get_linkage()
+        measure_name = self.get_distance_measure()
+        if linkage == LINKAGE_WARD and measure_name != "euclidean":
+            raise ValueError(
+                f"{measure_name} was provided as distance measure while linkage was "
+                "ward. Ward only works with euclidean."
+            )
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        n = X.shape[0]
+        num_clusters = self.get_num_clusters()
+        threshold = self.get_distance_threshold()
+        if threshold is not None:
+            num_clusters = 1  # threshold decides instead (reference semantics)
+        measure = DistanceMeasure.get_instance(measure_name)
+
+        import jax.numpy as jnp
+
+        dist = np.asarray(measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64)
+        np.fill_diagonal(dist, np.inf)
+        active = list(range(n))
+        sizes = np.ones(n, dtype=np.int64)
+        parent = np.arange(n)  # cluster membership via union-find-ish relabel
+        members = {i: [i] for i in range(n)}
+        merges = []  # (id1, id2, distance, merged size)
+        next_merge_stopped = None  # merge count at which the stop criterion hit
+        merge_count = 0
+        while len(active) > 1:
+            # find global closest pair among active clusters
+            sub = dist[np.ix_(active, active)]
+            flat = np.argmin(sub)
+            ai, aj = np.unravel_index(flat, sub.shape)
+            i, j = active[ai], active[aj]
+            d_ij = sub[ai, aj]
+            stop_hit = (
+                threshold is not None and d_ij > threshold
+            ) or (threshold is None and len(active) <= num_clusters)
+            if stop_hit and next_merge_stopped is None:
+                next_merge_stopped = merge_count
+                if not self.get_compute_full_tree():
+                    break
+            # merge j into i
+            lo, hi = (i, j) if i < j else (j, i)
+            merges.append((lo, hi, float(d_ij), int(sizes[i] + sizes[j])))
+            merge_count += 1
+            for k in active:
+                if k in (i, j):
+                    continue
+                dist[i, k] = dist[k, i] = _lance_williams_update(
+                    dist[i, k], dist[j, k], d_ij, sizes[i], sizes[j], sizes[k], linkage
+                )
+            sizes[i] += sizes[j]
+            members[i].extend(members.pop(j))
+            active.remove(j)
+        # labels: replay merges up to the stop point
+        stop_at = next_merge_stopped if next_merge_stopped is not None else len(merges)
+        label_members = {i: [i] for i in range(n)}
+        for lo, hi, _, _ in merges[:stop_at]:
+            target = lo if lo in label_members else hi
+            other = hi if target == lo else lo
+            if other in label_members and target in label_members and other != target:
+                label_members[target].extend(label_members.pop(other))
+        pred = np.zeros(n, dtype=np.int32)
+        for cluster_id, (_, rows) in enumerate(sorted(label_members.items())):
+            pred[rows] = cluster_id
+        out = table.with_column(self.get_prediction_col(), pred)
+        merge_table = Table(
+            {
+                "clusterId1": [m[0] for m in merges],
+                "clusterId2": [m[1] for m in merges],
+                "distance": [m[2] for m in merges],
+                "sizeOfMergedCluster": [m[3] for m in merges],
+            }
+        )
+        return [out, merge_table]

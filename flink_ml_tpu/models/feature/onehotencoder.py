@@ -61,10 +61,12 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
+        # The reference supports only handleInvalid=error
+        # (OneHotEncoderModel.java:73 checkArgument).
+        if self.get_handle_invalid() != HasHandleInvalid.ERROR_INVALID:
+            raise ValueError("OneHotEncoder only supports handleInvalid = 'error'")
         drop = 1 if self.get_drop_last() else 0
-        handle = self.get_handle_invalid()
         updates = {}
-        drop_mask = np.zeros(table.num_rows, dtype=bool)
         for i, (name, out_name) in enumerate(
             zip(self.get_input_cols(), self.get_output_cols())
         ):
@@ -73,23 +75,13 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
             int_idx = idx.astype(np.int64)
             if np.any(int_idx != idx) or np.any(int_idx < 0):
                 raise ValueError(f"Value cannot be parsed as indexed integer in column {name}")
-            invalid = int_idx > vec_size if drop else int_idx >= vec_size
-            if invalid.any():
-                if handle == HasHandleInvalid.ERROR_INVALID:
-                    raise ValueError(
-                        f"The input contains invalid index in column {name}. See "
-                        "handleInvalid parameter for more options."
-                    )
-                if handle == HasHandleInvalid.SKIP_INVALID:
-                    drop_mask |= invalid
+            if np.any(int_idx > vec_size if drop else int_idx >= vec_size):
+                raise ValueError(f"The input contains invalid index in column {name}.")
             # index == vec_size (the dropped last category) -> empty vector.
             indices = np.where(int_idx < vec_size, int_idx, -1).astype(np.int32)[:, None]
             values = np.where(indices >= 0, 1.0, 0.0)
             updates[out_name] = SparseBatch(vec_size, indices, values)
-        result = table.with_columns(updates)
-        if drop_mask.any():
-            result = result.take(np.nonzero(~drop_mask)[0])
-        return [result]
+        return [table.with_columns(updates)]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, categorySizes=self.category_sizes)

@@ -64,12 +64,13 @@ class SQLTransformer(Transformer):
                 rows,
             )
             # Track surviving row identities so non-scalar (vector) columns can
-            # pass through a `SELECT *`; falls back cleanly when the statement
-            # aggregates (rowid is then invalid in the select list).
+            # pass through a `SELECT *`. Only attempted for a star select with
+            # no aggregation — sqlite would otherwise return arbitrary
+            # per-group rowids rather than erroring.
             row_ids = None
             names, data = None, None
-            m = re.match(r"(?is)^\s*select\s+", sql)
-            if m is not None:
+            m = re.match(r"(?is)^\s*select\s+(?=\*)", sql)
+            if m is not None and not re.search(r"(?i)\bgroup\s+by\b|\bdistinct\b", sql):
                 with_rid = sql[: m.end()] + "rowid AS __rid__, " + sql[m.end():]
                 try:
                     cursor = conn.execute(with_rid)

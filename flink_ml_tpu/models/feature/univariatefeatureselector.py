@@ -167,9 +167,17 @@ class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
         mode = self.get_selection_mode()
         if threshold is None:
             threshold = _DEFAULT_THRESHOLDS[mode]
-        elif mode in (NUM_TOP_FEATURES,) and int(threshold) != threshold:
+        elif mode == NUM_TOP_FEATURES:
+            # UnivariateFeatureSelector.java:168-181 validation
+            if int(threshold) != threshold or threshold < 1:
+                raise ValueError(
+                    "SelectionThreshold needs to be a positive integer for "
+                    f"selection mode {mode}."
+                )
+        elif not 0.0 <= threshold <= 1.0:
             raise ValueError(
-                f"SelectionThreshold needs to be a positive integer for selection mode {mode}."
+                f"SelectionThreshold needs to be in the range [0, 1] for "
+                f"selection mode {mode}."
             )
         model = UnivariateFeatureSelectorModel()
         model.indices = select_indices_from_p_values(p_values, mode, float(threshold))

@@ -1,0 +1,50 @@
+"""ANOVATest — one-way ANOVA F-test stage.
+
+TPU-native re-design of stats/anovatest/ANOVATest.java:287 (flatten=false:
+{pValues, degreesOfFreedom, fValues}; flatten=true: one row per feature
+{featureIndex, pValue, degreeOfFreedom, fValue}). Math in ops/stats.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import AlgoOperator
+from ...common.param import HasFeaturesCol, HasFlatten, HasLabelCol
+from ...linalg import DenseVector
+from ...ops import stats
+from ...table import Table, as_dense_matrix
+
+
+class ANOVATestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
+    pass
+
+
+class ANOVATest(AlgoOperator, ANOVATestParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        p_values, dofs, f_values = stats.anova_f_test(X, y)
+        if self.get_flatten():
+            return [
+                Table(
+                    {
+                        "featureIndex": np.arange(len(p_values), dtype=np.int64),
+                        "pValue": p_values,
+                        "degreeOfFreedom": dofs,
+                        "fValue": f_values,
+                    }
+                )
+            ]
+        return [
+            Table(
+                {
+                    "pValues": [DenseVector(p_values)],
+                    "degreesOfFreedom": [dofs.tolist()],
+                    "fValues": [DenseVector(f_values)],
+                }
+            )
+        ]

@@ -1,0 +1,51 @@
+"""ChiSqTest — Pearson chi-square independence test stage.
+
+TPU-native re-design of stats/chisqtest/ChiSqTest.java (flatten=false: one
+row {pValues: vector, degreesOfFreedom: int array, statistics: vector};
+flatten=true: one row per feature {featureIndex, pValue, degreeOfFreedom,
+statistic}). The contingency math lives in ops/stats.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import AlgoOperator
+from ...common.param import HasFeaturesCol, HasFlatten, HasLabelCol
+from ...linalg import DenseVector
+from ...ops import stats
+from ...table import Table, as_dense_matrix
+
+
+class ChiSqTestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
+    pass
+
+
+class ChiSqTest(AlgoOperator, ChiSqTestParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        X = as_dense_matrix(table.column(self.get_features_col()))
+        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        p_values, dofs, statistics = stats.chi_square_test(X, y)
+        if self.get_flatten():
+            return [
+                Table(
+                    {
+                        "featureIndex": np.arange(len(p_values), dtype=np.int64),
+                        "pValue": p_values,
+                        "degreeOfFreedom": dofs,
+                        "statistic": statistics,
+                    }
+                )
+            ]
+        return [
+            Table(
+                {
+                    "pValues": [DenseVector(p_values)],
+                    "degreesOfFreedom": [dofs.tolist()],
+                    "statistics": [DenseVector(statistics)],
+                }
+            )
+        ]

@@ -1,33 +1,32 @@
 """Statistical test cores: chi-square, ANOVA F, F-value (regression).
 
 TPU-native re-design of the math inside stats/chisqtest/ChiSqTest.java,
-stats/anovatest/ANOVATest.java:287 and stats/fvaluetest/FValueTest.java.
+stats/anovatest/ANOVATest.java:194-235 and stats/fvaluetest/FValueTest.java.
 The reference computes contingency tables / group sums with keyed shuffles;
-here they are one-hot matmuls and segment sums over device arrays, and the
-p-values use jax.scipy.special (gammainc/betainc) instead of commons-math
-distributions. Shared by the stats stages and
-UnivariateFeatureSelector.java:305.
+here they are vectorized one-hot contractions. All arithmetic is float64
+(the reference uses commons-math doubles; float32 would visibly shift
+p-values) with the p-values from ops/special.py. Shared by the stats stages
+and UnivariateFeatureSelector.java:305.
 """
 
 from __future__ import annotations
 
 from typing import Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.scipy.special import betainc, gammainc
+
+from .special import betainc_reg, gammainc_p
 
 
 def chi2_sf(x, df):
-    """P[Chi2(df) > x] = 1 - gammainc(df/2, x/2) (regularized)."""
-    return 1.0 - gammainc(df / 2.0, x / 2.0)
+    """P[Chi2(df) > x] = 1 - P(df/2, x/2) (regularized lower inc. gamma)."""
+    return 1.0 - gammainc_p(np.asarray(df) / 2.0, np.asarray(x) / 2.0)
 
 
 def f_sf(x, dfn, dfd):
     """P[F(dfn, dfd) > x] via the regularized incomplete beta function."""
-    x = jnp.maximum(x, 0.0)
-    return betainc(dfd / 2.0, dfn / 2.0, dfd / (dfd + dfn * x))
+    x = np.maximum(np.asarray(x, dtype=np.float64), 0.0)
+    return betainc_reg(dfd / 2.0, dfn / 2.0, dfd / (dfd + dfn * x))
 
 
 def chi_square_test(
@@ -37,60 +36,51 @@ def chi_square_test(
     column against a categorical label. Returns (p_values, dofs, statistics).
 
     Mirrors ChiSqTest.java's contingency-table computation: observed counts
-    via a one-hot x one-hot matmul per feature (MXU segment-sum), expected
-    from the marginals.
+    via a one-hot x one-hot contraction per feature, expected from the
+    marginals.
     """
-    X = np.asarray(X)
+    X = np.asarray(X, dtype=np.float64)
     y = np.asarray(y)
     n, d = X.shape
     y_cats, y_idx = np.unique(y, return_inverse=True)
     k = len(y_cats)
     p_values, dofs, stats = [], [], []
-    y_onehot = jnp.asarray(np.eye(k)[y_idx])
     for j in range(d):
         f_cats, f_idx = np.unique(X[:, j], return_inverse=True)
         m = len(f_cats)
-        f_onehot = jnp.asarray(np.eye(m)[f_idx])
-        observed = f_onehot.T @ y_onehot  # (m, k) contingency table
+        # O(n) contingency table; a dense one-hot matmul would be O(n*m*k)
+        observed = np.bincount(f_idx * k + y_idx, minlength=m * k).reshape(m, k).astype(np.float64)
         row = observed.sum(axis=1, keepdims=True)
         col = observed.sum(axis=0, keepdims=True)
         expected = row * col / n
-        stat = float(jnp.sum(jnp.where(expected > 0, (observed - expected) ** 2 / expected, 0.0)))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            stat = float(
+                np.sum(np.where(expected > 0, (observed - expected) ** 2 / expected, 0.0))
+            )
         dof = (m - 1) * (k - 1)
-        p = float(chi2_sf(jnp.asarray(stat), jnp.asarray(float(dof)))) if dof > 0 else 1.0
+        p = float(chi2_sf(stat, float(dof))) if dof > 0 else 1.0
         p_values.append(p)
         dofs.append(dof)
         stats.append(stat)
     return np.asarray(p_values), np.asarray(dofs, dtype=np.int64), np.asarray(stats)
 
 
-@jax.jit
-def _anova_sums(X, y_onehot):
-    class_counts = y_onehot.sum(axis=0)  # (k,)
-    class_sums = y_onehot.T @ X  # (k, d) — MXU segment-sum
-    class_sq_sums = y_onehot.T @ (X * X)  # (k, d)
-    return class_counts, class_sums, class_sq_sums
-
-
 def anova_f_test(
     X: np.ndarray, y: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """One-way ANOVA F-test of each continuous feature against a categorical
-    label. Returns (p_values, dofs, f_statistics) — the dof reported is the
-    denominator dof n - k as in ANOVATest.java."""
+    label. Returns (p_values, dofs, f_statistics) with the reference's
+    reported dof = (k - 1) + (n - k) = n - 1 (ANOVATest.java:232)."""
     X = np.asarray(X, dtype=np.float64)
     y = np.asarray(y)
     n, d = X.shape
     y_cats, y_idx = np.unique(y, return_inverse=True)
     k = len(y_cats)
-    counts, sums, sq_sums = _anova_sums(
-        jnp.asarray(X), jnp.asarray(np.eye(k)[y_idx])
-    )
-    counts = np.asarray(counts)
-    sums = np.asarray(sums)
-    sq_sums = np.asarray(sq_sums)
+    y_onehot = np.eye(k)[y_idx]
+    counts = y_onehot.sum(axis=0)  # (k,)
+    sums = y_onehot.T @ X  # (k, d)
     total_sum = sums.sum(axis=0)
-    total_sq = sq_sums.sum(axis=0)
+    total_sq = (X * X).sum(axis=0)
     ss_tot = total_sq - total_sum**2 / n
     ss_between = (sums**2 / counts[:, None]).sum(axis=0) - total_sum**2 / n
     ss_within = ss_tot - ss_between
@@ -98,8 +88,8 @@ def anova_f_test(
     with np.errstate(divide="ignore", invalid="ignore"):
         f_stat = (ss_between / dfn) / (ss_within / dfd)
     f_stat = np.nan_to_num(f_stat, nan=0.0, posinf=np.inf)
-    p = np.asarray(f_sf(jnp.asarray(f_stat), float(dfn), float(dfd)))
-    return p, np.full(d, dfd, dtype=np.int64), f_stat
+    p = f_sf(f_stat, float(dfn), float(dfd))
+    return p, np.full(d, dfn + dfd, dtype=np.int64), f_stat
 
 
 def f_value_test(
@@ -121,5 +111,5 @@ def f_value_test(
     with np.errstate(divide="ignore", invalid="ignore"):
         f_stat = corr**2 / (1 - corr**2) * dfd
     f_stat = np.nan_to_num(f_stat, nan=0.0, posinf=np.inf)
-    p = np.asarray(f_sf(jnp.asarray(f_stat), 1.0, float(dfd)))
+    p = f_sf(f_stat, 1.0, float(dfd))
     return p, np.full(d, dfd, dtype=np.int64), f_stat

@@ -1,0 +1,144 @@
+"""NaiveBayes / Knn / AgglomerativeClustering batteries — mirror
+flink-ml-lib tests NaiveBayesTest.java, KnnTest.java,
+AgglomerativeClusteringTest.java."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.models.classification.naivebayes import NaiveBayes, NaiveBayesModel
+from flink_ml_tpu.models.classification.knn import Knn, KnnModel
+from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+    AgglomerativeClustering,
+)
+
+
+class TestNaiveBayes:
+    # NaiveBayesTest.java-style categorical data
+    def _train(self):
+        return Table(
+            {
+                "features": [
+                    Vectors.dense(0, 0),
+                    Vectors.dense(0, 1),
+                    Vectors.dense(1, 0),
+                    Vectors.dense(1, 1),
+                    Vectors.dense(1, 1),
+                ],
+                "label": [11.0, 11.0, 22.0, 22.0, 22.0],
+            }
+        )
+
+    def test_param_defaults(self):
+        nb = NaiveBayes()
+        assert nb.get_smoothing() == 1.0
+        assert nb.get_model_type() == "multinomial"
+
+    def test_fit_predict(self):
+        model = NaiveBayes().fit(self._train())
+        out = model.transform(self._train())[0]
+        pred = np.asarray(out.column("prediction"))
+        np.testing.assert_array_equal(pred, [11.0, 11.0, 22.0, 22.0, 22.0])
+
+    def test_unseen_value_raises(self):
+        model = NaiveBayes().fit(self._train())
+        with pytest.raises(ValueError):
+            model.transform(Table({"features": [Vectors.dense(9, 0)]}))
+
+    def test_save_load(self, tmp_path):
+        model = NaiveBayes().fit(self._train())
+        model.save(str(tmp_path / "nb"))
+        loaded = NaiveBayesModel.load(str(tmp_path / "nb"))
+        np.testing.assert_allclose(loaded.pi, model.pi)
+        out = loaded.transform(self._train())[0]
+        np.testing.assert_array_equal(
+            np.asarray(out.column("prediction")), [11.0, 11.0, 22.0, 22.0, 22.0]
+        )
+
+    def test_get_set_model_data(self):
+        model = NaiveBayes().fit(self._train())
+        other = NaiveBayesModel().set_model_data(model.get_model_data()[0])
+        np.testing.assert_allclose(other.pi, model.pi)
+
+
+class TestKnn:
+    def _train(self):
+        X = np.vstack([np.zeros((5, 2)), np.ones((5, 2)) * 10])
+        y = np.asarray([1.0] * 5 + [2.0] * 5)
+        return Table({"features": X, "label": y})
+
+    def test_param_defaults(self):
+        assert Knn().get_k() == 5
+
+    def test_fit_predict(self):
+        model = Knn().set_k(3).fit(self._train())
+        out = model.transform(
+            Table({"features": [[0.5, 0.5], [9.0, 9.5]]})
+        )[0]
+        np.testing.assert_array_equal(np.asarray(out.column("prediction")), [1.0, 2.0])
+
+    def test_k_larger_than_train(self):
+        t = Table({"features": [[0.0], [1.0]], "label": [5.0, 5.0]})
+        model = Knn().set_k(10).fit(t)
+        out = model.transform(t)[0]
+        np.testing.assert_array_equal(np.asarray(out.column("prediction")), [5.0, 5.0])
+
+    def test_save_load(self, tmp_path):
+        model = Knn().fit(self._train())
+        model.save(str(tmp_path / "knn"))
+        loaded = KnnModel.load(str(tmp_path / "knn"))
+        np.testing.assert_allclose(loaded.features, model.features)
+        out = loaded.transform(Table({"features": [[0.0, 0.0]]}))[0]
+        assert np.asarray(out.column("prediction"))[0] == 1.0
+
+    def test_get_set_model_data(self):
+        model = Knn().fit(self._train())
+        other = KnnModel().set_model_data(model.get_model_data()[0])
+        np.testing.assert_allclose(other.labels, model.labels)
+
+
+class TestAgglomerativeClustering:
+    # AgglomerativeClusteringTest.java-style data: two well-separated blobs
+    def _table(self):
+        X = np.asarray(
+            [[1.0, 1.0], [1.0, 4.0], [1.0, 0.0], [4.0, 1.5], [4.0, 4.0], [4.0, 0.0]]
+        )
+        return Table({"features": X})
+
+    def test_two_clusters_ward(self):
+        out, merges = AgglomerativeClustering().transform(self._table())
+        pred = np.asarray(out.column("prediction"))
+        assert len(set(pred)) == 2
+        # merge log has n - numClusters entries without full tree
+        assert merges.num_rows == 4
+
+    @pytest.mark.parametrize("linkage", ["single", "complete", "average"])
+    def test_linkages(self, linkage):
+        op = AgglomerativeClustering().set_linkage(linkage)
+        out, _ = op.transform(self._table())
+        pred = np.asarray(out.column("prediction"))
+        assert len(set(pred)) == 2
+
+    def test_distance_threshold(self):
+        op = AgglomerativeClustering().set_distance_threshold(1.2)
+        out, _ = op.transform(self._table())
+        pred = np.asarray(out.column("prediction"))
+        # only pairs closer than 1.2 merge -> more than 2 clusters
+        assert len(set(pred)) > 2
+
+    def test_full_tree(self):
+        op = AgglomerativeClustering().set_compute_full_tree(True)
+        out, merges = op.transform(self._table())
+        assert merges.num_rows == 5  # n - 1 merges for the full dendrogram
+        assert len(set(np.asarray(out.column("prediction")))) == 2
+
+    def test_ward_requires_euclidean(self):
+        with pytest.raises(ValueError):
+            AgglomerativeClustering().set_distance_measure("cosine").transform(self._table())
+
+    def test_save_load(self, tmp_path):
+        op = AgglomerativeClustering().set_num_clusters(3)
+        op.save(str(tmp_path / "agg"))
+        loaded = AgglomerativeClustering.load(str(tmp_path / "agg"))
+        assert loaded.get_num_clusters() == 3

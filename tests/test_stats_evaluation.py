@@ -1,0 +1,177 @@
+"""Stats stages + BinaryClassificationEvaluator batteries. Golden values are
+taken from the reference tests (ANOVATestTest.java EXPECTED_OUTPUT_DENSE,
+BinaryClassificationEvaluatorTest.java EXPECTED_DATA/_M/_W,
+FValueTestTest.java / ChiSqTestTest.java shapes)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.evaluation.binaryclassification import (
+    BinaryClassificationEvaluator,
+)
+from flink_ml_tpu.models.stats.anovatest import ANOVATest
+from flink_ml_tpu.models.stats.chisqtest import ChiSqTest
+from flink_ml_tpu.models.stats.fvaluetest import FValueTest
+
+# ANOVATestTest.java DENSE_INPUT_DATA (20 rows, labels 1..5, 6 features)
+ANOVA_LABELS = [3, 2, 1, 5, 4, 4, 5, 4, 2, 1, 1, 2, 3, 4, 5, 1, 5, 3, 1, 1]
+ANOVA_X = [
+    [0.85956061, 0.1645695, 0.48347596, 0.92102727, 0.42855644, 0.05746009],
+    [0.92500743, 0.65760154, 0.13295284, 0.53344893, 0.8994776, 0.24836496],
+    [0.03017182, 0.07244715, 0.87416449, 0.55843035, 0.91604736, 0.63346045],
+    [0.28325261, 0.36536881, 0.09223386, 0.37251258, 0.34742278, 0.70517077],
+    [0.64850904, 0.04090877, 0.21173176, 0.00148992, 0.13897166, 0.21182539],
+    [0.02609493, 0.44608735, 0.23910531, 0.95449222, 0.90763182, 0.8624905],
+    [0.09158744, 0.97745235, 0.41150139, 0.45830467, 0.52590925, 0.29441554],
+    [0.97211594, 0.1814442, 0.30340642, 0.17445413, 0.52756958, 0.02069296],
+    [0.06354593, 0.63527231, 0.49620335, 0.0141264, 0.62722219, 0.63497507],
+    [0.10814149, 0.8296426, 0.51775217, 0.57068344, 0.54633305, 0.12714921],
+    [0.72731796, 0.94010124, 0.45007811, 0.87650674, 0.53735565, 0.49568415],
+    [0.41827208, 0.85100628, 0.38685271, 0.60689503, 0.21784097, 0.91294433],
+    [0.65843656, 0.5880859, 0.18862706, 0.856398, 0.18029327, 0.94851926],
+    [0.3841634, 0.25138793, 0.96746644, 0.77048045, 0.44685196, 0.19813854],
+    [0.65982267, 0.23024125, 0.13598434, 0.60144265, 0.57848927, 0.85623564],
+    [0.35764189, 0.47623815, 0.5459232, 0.79508298, 0.14462443, 0.01802919],
+    [0.38532153, 0.90614554, 0.86629571, 0.13988735, 0.32062385, 0.00179492],
+    [0.2142368, 0.28306022, 0.59481646, 0.42567028, 0.52207663, 0.78082401],
+    [0.20788283, 0.76861782, 0.59595468, 0.62103642, 0.17781246, 0.77655345],
+    [0.1751708, 0.4547537, 0.46187865, 0.79781199, 0.05104487, 0.42406092],
+]
+ANOVA_EXPECTED_P = [0.64137831, 0.14830724, 0.69858474, 0.28038169, 0.86759161, 0.81608606]
+ANOVA_EXPECTED_F = [0.64110932, 1.98689258, 0.55499714, 1.40340562, 0.30881722, 0.3848595]
+
+
+class TestANOVATest:
+    def _table(self):
+        return Table({"features": np.asarray(ANOVA_X), "label": [float(l) for l in ANOVA_LABELS]})
+
+    def test_dense(self):
+        out = ANOVATest().transform(self._table())[0]
+        row = out.collect()[0]
+        np.testing.assert_allclose(row["pValues"].to_array(), ANOVA_EXPECTED_P, atol=1e-7)
+        np.testing.assert_allclose(row["fValues"].to_array(), ANOVA_EXPECTED_F, atol=1e-7)
+        assert list(row["degreesOfFreedom"]) == [19] * 6
+
+    def test_flattened(self):
+        out = ANOVATest().set_flatten(True).transform(self._table())[0]
+        assert out.num_rows == 6
+        np.testing.assert_array_equal(np.asarray(out.column("featureIndex")), np.arange(6))
+        np.testing.assert_allclose(np.asarray(out.column("pValue")), ANOVA_EXPECTED_P, atol=1e-7)
+
+
+class TestFValueTest:
+    def test_informative_feature(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(50, 3)
+        y = 3.0 * X[:, 1] + 0.01 * rng.randn(50)
+        out = FValueTest().transform(Table({"features": X, "label": y}))[0]
+        row = out.collect()[0]
+        p = row["pValues"].to_array()
+        assert p[1] < 1e-10 and p[0] > 0.01
+        assert list(row["degreesOfFreedom"]) == [48] * 3
+
+    def test_flattened_schema(self):
+        X = np.random.RandomState(1).rand(10, 2)
+        out = FValueTest().set_flatten(True).transform(Table({"features": X, "label": X[:, 0]}))[0]
+        assert out.column_names == ["featureIndex", "pValue", "degreeOfFreedom", "fValue"]
+
+
+class TestChiSqTest:
+    def _table(self):
+        # ChiSqTestTest.java-style categorical data
+        return Table(
+            {
+                "features": [
+                    Vectors.dense(0, 5),
+                    Vectors.dense(1, 6),
+                    Vectors.dense(2, 5),
+                    Vectors.dense(1, 5),
+                    Vectors.dense(0, 5),
+                    Vectors.dense(2, 6),
+                ],
+                "label": [0.0, 1.0, 0.0, 1.0, 0.0, 1.0],
+            }
+        )
+
+    def test_dense(self):
+        out = ChiSqTest().transform(self._table())[0]
+        row = out.collect()[0]
+        p = row["pValues"].to_array()
+        assert p.shape == (2,)
+        assert 0.0 <= p[0] <= 1.0 and 0.0 <= p[1] <= 1.0
+        # feature 0: contingency {0:(2,0), 1:(0,2), 2:(1,1)} -> stat 4, dof 2,
+        # p = exp(-2); dof = (m-1)*(k-1)
+        assert list(row["degreesOfFreedom"]) == [2, 1]
+        np.testing.assert_allclose(p[0], np.exp(-2.0), atol=1e-10)
+        np.testing.assert_allclose(row["statistics"].to_array()[0], 4.0, atol=1e-10)
+
+    def test_flattened(self):
+        out = ChiSqTest().set_flatten(True).transform(self._table())[0]
+        assert out.num_rows == 2
+        assert out.column_names == ["featureIndex", "pValue", "degreeOfFreedom", "statistic"]
+
+
+class TestBinaryClassificationEvaluator:
+    LABELS = [1.0, 1.0, 1.0, 0.0, 0.0, 1.0, 1.0, 0.0, 0.0, 1.0, 0.0, 1.0]
+    SCORES = [0.9, 0.8, 0.7, 0.75, 0.6, 0.65, 0.55, 0.4, 0.3, 0.35, 0.2, 0.1]
+
+    def _table(self):
+        raw = [Vectors.dense(1 - s, s) for s in self.SCORES]
+        return Table({"label": self.LABELS, "rawPrediction": raw})
+
+    def test_param_defaults(self):
+        ev = BinaryClassificationEvaluator()
+        assert ev.get_label_col() == "label"
+        assert ev.get_raw_prediction_col() == "rawPrediction"
+        assert ev.get_metrics_names() == ["areaUnderROC", "areaUnderPR"]
+
+    def test_evaluate(self):
+        # BinaryClassificationEvaluatorTest.java EXPECTED_DATA
+        ev = BinaryClassificationEvaluator().set_metrics_names(
+            "areaUnderPR", "ks", "areaUnderROC"
+        )
+        out = ev.transform(self._table())[0]
+        assert out.column_names == ["areaUnderPR", "ks", "areaUnderROC"]
+        row = out.collect()[0]
+        np.testing.assert_allclose(row["areaUnderPR"], 0.7691481137909708, atol=1e-5)
+        np.testing.assert_allclose(row["ks"], 0.3714285714285714, atol=1e-5)
+        np.testing.assert_allclose(row["areaUnderROC"], 0.6571428571428571, atol=1e-5)
+
+    def test_evaluate_double_raw(self):
+        t = Table({"label": self.LABELS, "rawPrediction": self.SCORES})
+        out = BinaryClassificationEvaluator().set_metrics_names("areaUnderROC").transform(t)[0]
+        np.testing.assert_allclose(out.collect()[0]["areaUnderROC"], 0.6571428571428571, atol=1e-5)
+
+    def test_evaluate_with_ties(self):
+        # EXPECTED_DATA_M: [auc, aupr, ks, lorenz]
+        scores = [0.9, 0.9, 0.9, 0.75, 0.6, 0.9, 0.9, 0.4, 0.3, 0.9, 0.2, 0.1]
+        raw = [Vectors.dense(1 - s, s) for s in scores]
+        t = Table({"label": self.LABELS, "rawPrediction": raw})
+        ev = BinaryClassificationEvaluator().set_metrics_names(
+            "areaUnderROC", "areaUnderPR", "ks", "areaUnderLorenz"
+        )
+        row = ev.transform(t)[0].collect()[0]
+        np.testing.assert_allclose(row["areaUnderROC"], 0.8571428571428571, atol=1e-5)
+        np.testing.assert_allclose(row["areaUnderPR"], 0.9377705627705628, atol=1e-5)
+        np.testing.assert_allclose(row["ks"], 0.8571428571428571, atol=1e-5)
+        np.testing.assert_allclose(row["areaUnderLorenz"], 0.6488095238095237, atol=1e-5)
+
+    def test_evaluate_weighted(self):
+        # EXPECTED_DATA_W
+        scores = [0.9, 0.9, 0.9, 0.75, 0.6, 0.9, 0.9, 0.4, 0.3, 0.9, 0.2, 0.1]
+        weights = [0.8, 0.7, 0.5, 1.2, 1.3, 1.5, 1.4, 0.3, 0.5, 1.9, 1.2, 1.0]
+        raw = [Vectors.dense(1 - s, s) for s in scores]
+        t = Table({"label": self.LABELS, "rawPrediction": raw, "weight": weights})
+        ev = (
+            BinaryClassificationEvaluator()
+            .set_metrics_names("areaUnderROC")
+            .set_weight_col("weight")
+        )
+        row = ev.transform(t)[0].collect()[0]
+        np.testing.assert_allclose(row["areaUnderROC"], 0.8911680911680911, atol=1e-5)
+
+    def test_invalid_metric_rejected(self):
+        with pytest.raises(ValueError):
+            BinaryClassificationEvaluator().set_metrics_names("nope")
