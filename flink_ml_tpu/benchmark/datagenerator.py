@@ -1,0 +1,221 @@
+"""Benchmark data generators — param-driven random table sources.
+
+TPU-native re-design of flink-ml-benchmark/.../datagenerator/ (
+DataGenerator.java, InputDataGenerator.java:NUM_VALUES/COL_NAMES/SEED,
+common/DenseVectorGenerator.java, DenseVectorArrayGenerator.java,
+DoubleGenerator.java, LabeledPointWithWeightGenerator.java,
+RandomStringGenerator.java, RandomStringArrayGenerator.java,
+clustering/KMeansModelDataGenerator.java). Same param names/JSON configs;
+generation is vectorized numpy instead of per-row Flink sources.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..common.param import HasSeed
+from ..param import IntParam, LongParam, Param, ParamValidators
+from ..table import Table
+
+
+class _ColNamesParam(Param):
+    """String[][] colNames (InputDataGenerator.java COL_NAMES)."""
+
+    def json_encode(self, value):
+        return value
+
+    def json_decode(self, json_value):
+        return json_value
+
+
+class DataGenerator(HasSeed):
+    """Base generator: getData() -> list of Tables (DataGenerator.java)."""
+
+    NUM_VALUES = LongParam(
+        "numValues", "Number of data rows to generate.", 10, ParamValidators.gt(0)
+    )
+    COL_NAMES = _ColNamesParam("colNames", "Column names of the generated tables.", None)
+
+    def get_num_values(self) -> int:
+        return self.get(self.NUM_VALUES)
+
+    def set_num_values(self, value: int):
+        return self.set(self.NUM_VALUES, value)
+
+    def get_col_names(self):
+        return self.get(self.COL_NAMES)
+
+    def set_col_names(self, *values):
+        return self.set(self.COL_NAMES, [list(v) for v in values])
+
+    def _rng(self) -> np.random.RandomState:
+        return np.random.RandomState(self.get_seed() % (2**32))
+
+    def get_data(self) -> List[Table]:
+        raise NotImplementedError
+
+
+class DenseVectorGenerator(DataGenerator):
+    """Random uniform dense vectors (common/DenseVectorGenerator.java)."""
+
+    VECTOR_DIM = IntParam("vectorDim", "Dimension of generated vectors.", 1, ParamValidators.gt(0))
+
+    def get_vector_dim(self) -> int:
+        return self.get(self.VECTOR_DIM)
+
+    def set_vector_dim(self, value: int):
+        return self.set(self.VECTOR_DIM, value)
+
+    def get_data(self) -> List[Table]:
+        (names,) = self.get_col_names()
+        X = self._rng().rand(self.get_num_values(), self.get_vector_dim())
+        return [Table({names[0]: X})]
+
+
+class DenseVectorArrayGenerator(DenseVectorGenerator):
+    """Arrays of dense vectors per row (common/DenseVectorArrayGenerator.java)."""
+
+    ARRAY_SIZE = IntParam("arraySize", "Size of the vector array.", 1, ParamValidators.gt(0))
+
+    def get_array_size(self) -> int:
+        return self.get(self.ARRAY_SIZE)
+
+    def set_array_size(self, value: int):
+        return self.set(self.ARRAY_SIZE, value)
+
+    def get_data(self) -> List[Table]:
+        from ..linalg import DenseVector
+
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, k, d = self.get_num_values(), self.get_array_size(), self.get_vector_dim()
+        col = np.empty(n, dtype=object)
+        for i in range(n):
+            col[i] = [DenseVector(rng.rand(d)) for _ in range(k)]
+        return [Table({names[0]: col})]
+
+
+class DoubleGenerator(DataGenerator):
+    """Random uniform doubles (common/DoubleGenerator.java)."""
+
+    def get_data(self) -> List[Table]:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        return [Table({name: rng.rand(self.get_num_values()) for name in names})]
+
+
+class LabeledPointWithWeightGenerator(DataGenerator):
+    """(features, label, weight) rows (common/LabeledPointWithWeightGenerator.java):
+    feature values uniform in [0,1) or categorical of featureArity; label
+    uniform integer in [0, labelArity); weight uniform in [0,1)."""
+
+    FEATURE_ARITY = IntParam(
+        "featureArity",
+        "Arity of each feature: 0 means continuous in [0, 1).",
+        2,
+        ParamValidators.gt_eq(0),
+    )
+    LABEL_ARITY = IntParam(
+        "labelArity", "Arity of the label.", 2, ParamValidators.gt(1)
+    )
+    VECTOR_DIM = IntParam("vectorDim", "Dimension of the feature vector.", 1, ParamValidators.gt(0))
+
+    def get_feature_arity(self) -> int:
+        return self.get(self.FEATURE_ARITY)
+
+    def set_feature_arity(self, value: int):
+        return self.set(self.FEATURE_ARITY, value)
+
+    def get_label_arity(self) -> int:
+        return self.get(self.LABEL_ARITY)
+
+    def set_label_arity(self, value: int):
+        return self.set(self.LABEL_ARITY, value)
+
+    def get_vector_dim(self) -> int:
+        return self.get(self.VECTOR_DIM)
+
+    def set_vector_dim(self, value: int):
+        return self.set(self.VECTOR_DIM, value)
+
+    def get_data(self) -> List[Table]:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, d = self.get_num_values(), self.get_vector_dim()
+        arity = self.get_feature_arity()
+        if arity == 0:
+            X = rng.rand(n, d)
+        else:
+            X = rng.randint(0, arity, size=(n, d)).astype(np.float64)
+        y = rng.randint(0, self.get_label_arity(), size=n).astype(np.float64)
+        w = rng.rand(n)
+        return [Table({names[0]: X, names[1]: y, names[2]: w})]
+
+
+class RandomStringGenerator(DataGenerator):
+    """Random strings from a fixed-size token universe
+    (common/RandomStringGenerator.java)."""
+
+    NUM_DISTINCT_VALUES = IntParam(
+        "numDistinctValues", "Number of distinct string values.", 10, ParamValidators.gt(0)
+    )
+
+    def get_num_distinct_values(self) -> int:
+        return self.get(self.NUM_DISTINCT_VALUES)
+
+    def set_num_distinct_values(self, value: int):
+        return self.set(self.NUM_DISTINCT_VALUES, value)
+
+    def get_data(self) -> List[Table]:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, m = self.get_num_values(), self.get_num_distinct_values()
+        cols = {}
+        for name in names:
+            cols[name] = np.asarray(
+                [str(v) for v in rng.randint(0, m, size=n)], dtype=object
+            )
+        return [Table(cols)]
+
+
+class RandomStringArrayGenerator(RandomStringGenerator):
+    """Arrays of random strings (common/RandomStringArrayGenerator.java)."""
+
+    ARRAY_SIZE = IntParam("arraySize", "Size of the string arrays.", 1, ParamValidators.gt(0))
+
+    def get_array_size(self) -> int:
+        return self.get(self.ARRAY_SIZE)
+
+    def set_array_size(self, value: int):
+        return self.set(self.ARRAY_SIZE, value)
+
+    def get_data(self) -> List[Table]:
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        n, m, k = self.get_num_values(), self.get_num_distinct_values(), self.get_array_size()
+        cols = {}
+        for name in names:
+            col = np.empty(n, dtype=object)
+            for i in range(n):
+                col[i] = [str(v) for v in rng.randint(0, m, size=k)]
+            cols[name] = col
+        return [Table(cols)]
+
+
+class KMeansModelDataGenerator(DataGenerator):
+    """Random KMeansModelData (clustering/KMeansModelDataGenerator.java)."""
+
+    ARRAY_SIZE = IntParam("arraySize", "Number of centroids.", 2, ParamValidators.gt(0))
+    VECTOR_DIM = IntParam("vectorDim", "Dimension of centroids.", 1, ParamValidators.gt(0))
+
+    def get_data(self) -> List[Table]:
+        from ..linalg import DenseVector
+
+        (names,) = self.get_col_names()
+        rng = self._rng()
+        k, d = self.get(self.ARRAY_SIZE), self.get(self.VECTOR_DIM)
+        centroids = [DenseVector(rng.rand(d)) for _ in range(k)]
+        weights = DenseVector(np.zeros(k))
+        return [Table({names[0]: [centroids], names[1]: [weights]})]

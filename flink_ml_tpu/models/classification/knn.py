@@ -39,13 +39,13 @@ class KnnParams(KnnModelParams, HasLabelCol):
 
 
 @partial(jax.jit, static_argnames=("k",))
-def _top_k_labels(X_test, X_train, y_train, k):
-    """Squared-euclidean pairwise distances -> top-k neighbor labels."""
+def _top_k_indices(X_test, X_train, k):
+    """Squared-euclidean pairwise distances -> top-k neighbor indices."""
     t2 = jnp.sum(X_test * X_test, axis=1, keepdims=True)
     r2 = jnp.sum(X_train * X_train, axis=1)[None, :]
     dists = t2 - 2.0 * (X_test @ X_train.T) + r2
     _, idx = jax.lax.top_k(-dists, k)  # (n_test, k)
-    return y_train[idx]
+    return idx
 
 
 class KnnModel(Model, KnnModelParams):
@@ -66,15 +66,13 @@ class KnnModel(Model, KnnModelParams):
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()))
         k = min(self.get_k(), self.features.shape[0])
-        neighbor_labels = np.asarray(
-            _top_k_labels(
-                jnp.asarray(X, jnp.float32),
-                jnp.asarray(self.features, jnp.float32),
-                jnp.asarray(self.labels, jnp.float32),
-                k,
-            ),
-            dtype=np.float64,
+        idx = np.asarray(
+            _top_k_indices(
+                jnp.asarray(X, jnp.float32), jnp.asarray(self.features, jnp.float32), k
+            )
         )
+        # gather labels host-side in float64 so exact label values survive
+        neighbor_labels = self.labels[idx]
         # majority vote per row (KnnModel.java voting)
         pred = np.empty(X.shape[0], dtype=np.float64)
         for i, row in enumerate(neighbor_labels):

@@ -87,19 +87,22 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
         num_labels = len(self.labels)
         probs = np.tile(self.pi, (n, 1))  # (n, numLabels)
         for j in range(d):
-            # vectorized map lookup per feature: build value -> per-label logp
-            mapping = {}
-            for i in range(num_labels):
-                for v, logp in self.theta[i][j].items():
-                    mapping.setdefault(v, np.full(num_labels, np.nan))[i] = logp
+            # columnwise: sorted category values + (num_values, num_labels)
+            # log-prob matrix, then one searchsorted gather per feature
+            values = np.asarray(sorted(self.theta[0][j]), dtype=np.float64)
+            logp = np.stack(
+                [[self.theta[i][j][v] for i in range(num_labels)] for v in values]
+            )  # (num_values, num_labels)
             col = X[:, j]
-            for r in range(n):
-                v = float(col[r])
-                if v not in mapping:
-                    raise ValueError(
-                        f"Feature value {v} in column {j} was not seen during training"
-                    )
-                probs[r] += mapping[v]
+            pos = np.searchsorted(values, col)
+            pos_clipped = np.clip(pos, 0, values.size - 1)
+            unseen = (pos >= values.size) | (values[pos_clipped] != col)
+            if unseen.any():
+                bad = float(col[np.nonzero(unseen)[0][0]])
+                raise ValueError(
+                    f"Feature value {bad} in column {j} was not seen during training"
+                )
+            probs += logp[pos_clipped]
         pred = self.labels[np.argmax(probs, axis=1)]
         return [table.with_column(self.get_prediction_col(), pred)]
 

@@ -119,51 +119,54 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
 
         dist = np.asarray(measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64)
         np.fill_diagonal(dist, np.inf)
-        active = list(range(n))
+        num_active = n
         sizes = np.ones(n, dtype=np.int64)
-        parent = np.arange(n)  # cluster membership via union-find-ish relabel
+        # fresh id for every merged cluster (n, n+1, ...) — the reference's
+        # reOrderNnChain convention for the merge log
+        cluster_ids = list(range(n))
         members = {i: [i] for i in range(n)}
         merges = []  # (id1, id2, distance, merged size)
+        merge_members = []  # row sets merged at each step, for labeling
         next_merge_stopped = None  # merge count at which the stop criterion hit
-        merge_count = 0
-        while len(active) > 1:
-            # find global closest pair among active clusters
-            sub = dist[np.ix_(active, active)]
-            flat = np.argmin(sub)
-            ai, aj = np.unravel_index(flat, sub.shape)
-            i, j = active[ai], active[aj]
-            d_ij = sub[ai, aj]
+        while num_active > 1:
+            # global closest pair; merged rows/cols are masked to +inf so no
+            # per-iteration submatrix copies are needed
+            flat = np.argmin(dist)
+            i, j = np.unravel_index(flat, dist.shape)
+            d_ij = dist[i, j]
             stop_hit = (
                 threshold is not None and d_ij > threshold
-            ) or (threshold is None and len(active) <= num_clusters)
+            ) or (threshold is None and num_active <= num_clusters)
             if stop_hit and next_merge_stopped is None:
-                next_merge_stopped = merge_count
+                next_merge_stopped = len(merges)
                 if not self.get_compute_full_tree():
                     break
-            # merge j into i
-            lo, hi = (i, j) if i < j else (j, i)
+            # merge j into i (log the pre-merge cluster ids, sorted)
+            id_i, id_j = cluster_ids[i], cluster_ids[j]
+            lo, hi = (id_i, id_j) if id_i < id_j else (id_j, id_i)
             merges.append((lo, hi, float(d_ij), int(sizes[i] + sizes[j])))
-            merge_count += 1
-            for k in active:
-                if k in (i, j):
-                    continue
-                dist[i, k] = dist[k, i] = _lance_williams_update(
-                    dist[i, k], dist[j, k], d_ij, sizes[i], sizes[j], sizes[k], linkage
-                )
+            # Lance-Williams row update against every other live cluster
+            new_row = _lance_williams_update(
+                dist[i], dist[j], d_ij, sizes[i], sizes[j], sizes, linkage
+            )
+            finite = np.isfinite(dist[i]) & np.isfinite(dist[j])
+            dist[i, finite] = new_row[finite]
+            dist[finite, i] = new_row[finite]
+            dist[i, i] = np.inf
+            dist[j, :] = np.inf
+            dist[:, j] = np.inf
             sizes[i] += sizes[j]
+            cluster_ids[i] = n + len(merges) - 1
             members[i].extend(members.pop(j))
-            active.remove(j)
+            merge_members.append(list(members[i]))
+            num_active -= 1
         # labels: replay merges up to the stop point
         stop_at = next_merge_stopped if next_merge_stopped is not None else len(merges)
-        label_members = {i: [i] for i in range(n)}
-        for lo, hi, _, _ in merges[:stop_at]:
-            target = lo if lo in label_members else hi
-            other = hi if target == lo else lo
-            if other in label_members and target in label_members and other != target:
-                label_members[target].extend(label_members.pop(other))
-        pred = np.zeros(n, dtype=np.int32)
-        for cluster_id, (_, rows) in enumerate(sorted(label_members.items())):
-            pred[rows] = cluster_id
+        pred = np.arange(n, dtype=np.int64)
+        for rows in merge_members[:stop_at]:
+            pred[rows] = min(pred[r] for r in rows)
+        _, pred = np.unique(pred, return_inverse=True)
+        pred = pred.astype(np.int32)
         out = table.with_column(self.get_prediction_col(), pred)
         merge_table = Table(
             {

@@ -22,6 +22,9 @@ from ...common.param import HasLabelCol, HasRawPredictionCol, HasWeightCol
 from ...param import ParamValidators, StringArrayParam
 from ...table import Table
 
+# numpy 2 renamed trapz -> trapezoid; support both
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz
+
 AREA_UNDER_ROC = "areaUnderROC"
 AREA_UNDER_PR = "areaUnderPR"
 AREA_UNDER_LORENZ = "areaUnderLorenz"
@@ -94,8 +97,8 @@ def _binary_metrics(scores: np.ndarray, labels: np.ndarray, weights: np.ndarray)
     else:
         auc = float("nan")
 
-    aupr = float(np.trapezoid(prec_pts, tpr_pts))
-    lorenz = float(np.trapezoid(tpr_pts, rate_pts))
+    aupr = float(_trapezoid(prec_pts, tpr_pts))
+    lorenz = float(_trapezoid(tpr_pts, rate_pts))
     ks = float(np.max(np.abs(tpr_pts - fpr_pts)))
     return {
         AREA_UNDER_ROC: float(auc),

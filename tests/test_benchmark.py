@@ -1,0 +1,121 @@
+"""Benchmark harness battery — mirrors flink-ml-benchmark BenchmarkTest.java
+/ DataGeneratorTest.java: config parsing (incl. the reference's commented
+JSON files), generator determinism, result schema."""
+
+import json
+
+import numpy as np
+
+from flink_ml_tpu.benchmark.datagenerator import (
+    DenseVectorGenerator,
+    DoubleGenerator,
+    KMeansModelDataGenerator,
+    LabeledPointWithWeightGenerator,
+    RandomStringArrayGenerator,
+    RandomStringGenerator,
+)
+from flink_ml_tpu.benchmark.runner import execute_benchmarks, load_config, run_benchmark
+
+
+class TestGenerators:
+    def test_dense_vector_generator(self):
+        gen = DenseVectorGenerator().set_col_names(["features"]).set_num_values(100).set_vector_dim(5)
+        (table,) = gen.get_data()
+        assert table.num_rows == 100
+        assert np.asarray(table.column("features")).shape == (100, 5)
+
+    def test_deterministic_by_seed(self):
+        def make():
+            return (
+                DenseVectorGenerator()
+                .set_col_names(["f"]).set_num_values(10).set_vector_dim(3).set_seed(7)
+            ).get_data()[0]
+
+        np.testing.assert_array_equal(
+            np.asarray(make().column("f")), np.asarray(make().column("f"))
+        )
+
+    def test_labeled_point_generator(self):
+        gen = (
+            LabeledPointWithWeightGenerator()
+            .set_col_names(["features", "label", "weight"])
+            .set_num_values(50).set_vector_dim(4).set_label_arity(3)
+        )
+        (table,) = gen.get_data()
+        labels = np.asarray(table.column("label"))
+        assert set(labels).issubset({0.0, 1.0, 2.0})
+        assert np.asarray(table.column("features")).shape == (50, 4)
+
+    def test_string_generators(self):
+        (t,) = RandomStringGenerator().set_col_names(["s"]).set_num_values(20).get_data()
+        assert all(isinstance(v, str) for v in t.column("s"))
+        (t2,) = (
+            RandomStringArrayGenerator()
+            .set_col_names(["s"]).set_num_values(5).set_array_size(3)
+        ).get_data()
+        assert all(len(v) == 3 for v in t2.column("s"))
+
+    def test_double_generator(self):
+        (t,) = DoubleGenerator().set_col_names(["a", "b"]).set_num_values(10).get_data()
+        assert t.column_names == ["a", "b"]
+
+    def test_kmeans_model_data_generator(self):
+        gen = KMeansModelDataGenerator().set_col_names(["centroids", "weights"])
+        gen.set(gen.ARRAY_SIZE, 3).set(gen.VECTOR_DIM, 2)
+        (t,) = gen.get_data()
+        assert t.num_rows == 1
+
+
+class TestRunner:
+    def test_run_benchmark_schema(self):
+        entry = {
+            "stage": {
+                "className": "org.apache.flink.ml.clustering.kmeans.KMeans",
+                "paramMap": {"k": 2, "maxIter": 3},
+            },
+            "inputData": {
+                "className": "org.apache.flink.ml.benchmark.datagenerator.common.DenseVectorGenerator",
+                "paramMap": {"seed": 2, "colNames": [["features"]], "numValues": 200, "vectorDim": 5},
+            },
+        }
+        result = run_benchmark("KMeans-1", entry)
+        assert set(result) == {
+            "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
+            "outputRecordNum", "outputThroughput",
+        }
+        assert result["inputRecordNum"] == 200
+        assert result["totalTimeMs"] > 0
+
+    def test_model_transform_benchmark(self):
+        entry = {
+            "stage": {
+                "className": "org.apache.flink.ml.clustering.kmeans.KMeansModel",
+                "paramMap": {},
+            },
+            "modelData": {
+                "className": "org.apache.flink.ml.benchmark.datagenerator.clustering.KMeansModelDataGenerator",
+                "paramMap": {"colNames": [["centroids", "weights"]], "arraySize": 3, "vectorDim": 5},
+            },
+            "inputData": {
+                "className": "org.apache.flink.ml.benchmark.datagenerator.common.DenseVectorGenerator",
+                "paramMap": {"colNames": [["features"]], "numValues": 100, "vectorDim": 5},
+            },
+        }
+        result = run_benchmark("KMeansModel-1", entry)
+        assert result["outputRecordNum"] == 100
+
+    def test_load_reference_config(self):
+        """The reference's shipped configs (with // license headers) parse."""
+        cfg = load_config(
+            "/root/reference/flink-ml-benchmark/src/main/resources/kmeans-benchmark.json"
+        )
+        assert "KMeans" in cfg
+        assert cfg["KMeans"]["stage"]["className"].endswith("KMeans")
+
+    def test_shipped_demo_config(self, tmp_path):
+        cfg = load_config("conf/benchmark-demo.json")
+        # shrink to keep the test fast
+        small = {"version": 1, "StandardScaler-1": cfg["StandardScaler-1"]}
+        small["StandardScaler-1"]["inputData"]["paramMap"]["numValues"] = 100
+        results = execute_benchmarks(small)
+        assert "StandardScaler-1" in results

@@ -1,0 +1,131 @@
+"""Graph/GraphBuilder battery — mirrors flink-ml-core GraphTest.java /
+GraphBuilderTest.java: DAG wiring, estimator+model semantics, model-data
+edges, save/load."""
+
+import numpy as np
+
+from flink_ml_tpu.graph import Graph, GraphBuilder, GraphModel
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+from flink_ml_tpu.models.feature.minmaxscaler import MinMaxScaler, MinMaxScalerModel
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+
+
+def _train_table():
+    rng = np.random.RandomState(0)
+    X = np.vstack([rng.randn(100, 4) + 2, rng.randn(100, 4) - 2])
+    y = np.array([1.0] * 100 + [0.0] * 100)
+    return Table({"features": X, "label": y})
+
+
+def test_chained_estimators():
+    """scaler -> lr chained through the builder behaves like a Pipeline."""
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler().set_input_col("features").set_output_col("scaled")
+    lr = LogisticRegression().set_features_col("scaled").set_max_iter(20)
+    scaled = builder.add_estimator(scaler, [source])
+    outputs = builder.add_estimator(lr, [scaled[0]])
+    graph = builder.build_estimator([source], [outputs[0]])
+
+    t = _train_table()
+    model = graph.fit(t)
+    assert isinstance(model, GraphModel)
+    out = model.transform(t)[0]
+    pred = np.asarray(out.column("prediction"))
+    assert (pred == np.asarray(t.column("label"))).mean() > 0.95
+
+
+def test_algo_operator_nodes():
+    from flink_ml_tpu.models.feature.vectorassembler import VectorAssembler
+
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    assembler = VectorAssembler().set_input_cols("a", "b").set_output_col("vec")
+    outputs = builder.add_algo_operator(assembler, source)
+    op = builder.build_algo_operator([source], [outputs[0]])
+    t = Table({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    out = op.transform(t)[0]
+    np.testing.assert_array_equal(np.asarray(out.column("vec")), [[1, 3], [2, 4]])
+
+
+def test_model_data_edges():
+    """getModelDataFromEstimator -> setModelDataOnModel wiring
+    (GraphBuilder.java:169-257)."""
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = MinMaxScaler()
+    builder.add_estimator(scaler, [source])
+    model_data = builder.get_model_data_from_estimator(scaler)
+
+    consumer = MinMaxScalerModel()
+    builder.set_model_data_on_model(consumer, model_data[0])
+    outputs = builder.add_algo_operator(consumer, source)
+    graph = builder.build_estimator([source], [outputs[0]])
+
+    t = Table({"input": np.arange(10, dtype=np.float64)[:, None]})
+    model = graph.fit(t)
+    out = model.transform(t)[0]
+    got = np.asarray(out.column("output"))
+    np.testing.assert_allclose(got[:, 0], np.arange(10) / 9.0, atol=1e-7)
+
+
+def test_save_load_graph(tmp_path):
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler().set_input_col("features").set_output_col("scaled")
+    lr = LogisticRegression().set_features_col("scaled").set_max_iter(10)
+    scaled = builder.add_estimator(scaler, [source])
+    outputs = builder.add_estimator(lr, [scaled[0]])
+    graph = builder.build_estimator([source], [outputs[0]])
+
+    path = str(tmp_path / "graph")
+    graph.save(path)
+    loaded = Graph.load(path)
+    t = _train_table()
+    model = loaded.fit(t)
+    out = model.transform(t)[0]
+    assert "prediction" in out.column_names
+
+
+def test_save_load_graph_model(tmp_path):
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler().set_input_col("features").set_output_col("scaled")
+    lr = LogisticRegression().set_features_col("scaled").set_max_iter(10)
+    scaled = builder.add_estimator(scaler, [source])
+    outputs = builder.add_estimator(lr, [scaled[0]])
+    graph = builder.build_estimator([source], [outputs[0]])
+    t = _train_table()
+    model = graph.fit(t)
+    expected = np.asarray(model.transform(t)[0].column("prediction"))
+
+    path = str(tmp_path / "graph_model")
+    model.save(path)
+    loaded = GraphModel.load(path)
+    got = np.asarray(loaded.transform(t)[0].column("prediction"))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_unsatisfiable_graph_raises():
+    import pytest
+
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    dangling = builder.create_table_id()  # never produced
+    scaler = StandardScaler()
+    outputs = builder.add_estimator(scaler, [dangling])
+    graph = builder.build_estimator([source], [outputs[0]])
+    with pytest.raises(ValueError):
+        graph.fit(Table({"input": [[1.0]]}))
+
+
+def test_duplicate_stage_rejected():
+    import pytest
+
+    builder = GraphBuilder()
+    source = builder.create_table_id()
+    scaler = StandardScaler()
+    builder.add_estimator(scaler, [source])
+    with pytest.raises(ValueError):
+        builder.add_estimator(scaler, [source])

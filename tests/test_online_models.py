@@ -1,0 +1,191 @@
+"""Online-algorithm batteries — mirror OnlineKMeansTest.java and
+OnlineLogisticRegressionTest.java: per-batch model versions, decayed
+centroid updates, FTRL convergence, save/load."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.table import StreamTable, Table
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.classification.onlinelogisticregression import (
+    OnlineLogisticRegression,
+    OnlineLogisticRegressionModel,
+)
+from flink_ml_tpu.models.clustering.kmeans import KMeans
+from flink_ml_tpu.models.clustering.onlinekmeans import (
+    OnlineKMeans,
+    OnlineKMeansModel,
+    generate_random_model_data,
+)
+
+
+def _blob_batches(num_batches, batch_size, seed=0):
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(num_batches):
+        a = rng.randn(batch_size // 2, 2) * 0.1 + [0, 0]
+        b = rng.randn(batch_size // 2, 2) * 0.1 + [10, 10]
+        batches.append(Table({"features": np.vstack([a, b])}))
+    return batches
+
+
+class TestOnlineKMeans:
+    def test_requires_stream_and_init(self):
+        with pytest.raises(TypeError):
+            OnlineKMeans().set_initial_model_data(
+                generate_random_model_data(2, 2, 1.0)
+            ).fit(Table({"features": [[0.0, 0.0]]}))
+        with pytest.raises(ValueError):
+            OnlineKMeans().fit(StreamTable.from_batches([]))
+
+    def test_online_updates_and_versions(self):
+        batches = _blob_batches(4, 10)
+        okm = (
+            OnlineKMeans()
+            .set_global_batch_size(10)
+            .set_initial_model_data(generate_random_model_data(2, 2, 0.0, seed=5))
+        )
+        model = okm.fit(StreamTable.from_batches(batches))
+        assert model.model_version == 0
+        model.process_updates(max_batches=1)
+        assert model.model_version == 1
+        model.process_updates()
+        assert model.model_version == 4
+        # centroids converge near the blob centers
+        sorted_c = model.centroids[np.argsort(model.centroids[:, 0])]
+        np.testing.assert_allclose(sorted_c[0], [0, 0], atol=0.5)
+        np.testing.assert_allclose(sorted_c[1], [10, 10], atol=0.5)
+        out = model.transform(Table({"features": [[0.1, 0.0], [9.9, 10.0]]}))[0]
+        pred = np.asarray(out.column("prediction"))
+        assert pred[0] != pred[1]
+
+    def test_init_from_batch_kmeans(self):
+        t = Table({"features": np.vstack([np.zeros((5, 2)), np.ones((5, 2)) * 10])})
+        batch_model = KMeans().set_seed(1).fit(t)
+        okm = (
+            OnlineKMeans()
+            .set_global_batch_size(10)
+            .set_initial_model_data(batch_model.get_model_data()[0])
+        )
+        model = okm.fit(StreamTable.from_batches(_blob_batches(2, 10)))
+        model.process_updates()
+        assert model.model_version == 2
+
+    def test_decay_factor_full_forget(self):
+        # decay 0 -> old centroids forgotten when a batch hits the cluster
+        okm = (
+            OnlineKMeans()
+            .set_global_batch_size(4)
+            .set_decay_factor(0.0)
+            .set_initial_model_data(generate_random_model_data(2, 2, 100.0, seed=3))
+        )
+        batch = Table({"features": [[0.0, 0.0], [0.1, 0.1], [10.0, 10.0], [10.1, 10.1]]})
+        model = okm.fit(StreamTable.from_batches([batch]))
+        model.process_updates()
+        sorted_c = model.centroids[np.argsort(model.centroids[:, 0])]
+        np.testing.assert_allclose(sorted_c[0], [0.05, 0.05], atol=0.2)
+
+    def test_save_load(self, tmp_path):
+        okm = (
+            OnlineKMeans()
+            .set_global_batch_size(10)
+            .set_initial_model_data(generate_random_model_data(2, 2, 0.0, seed=5))
+        )
+        model = okm.fit(StreamTable.from_batches(_blob_batches(2, 10)))
+        model.process_updates()
+        model.save(str(tmp_path / "okm"))
+        loaded = OnlineKMeansModel.load(str(tmp_path / "okm"))
+        np.testing.assert_allclose(loaded.centroids, model.centroids)
+        assert loaded.model_version == 2
+
+
+def _classification_batches(num_batches, batch_size, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    truth = np.linspace(1, -1, dim)
+    batches = []
+    for _ in range(num_batches):
+        X = rng.randn(batch_size, dim)
+        y = (X @ truth > 0).astype(np.float64)
+        batches.append(Table({"features": X, "label": y}))
+    return batches
+
+
+class TestOnlineLogisticRegression:
+    def _initial_model(self, dim=4):
+        from flink_ml_tpu.linalg import DenseVector
+
+        return Table({"coefficient": [DenseVector(np.zeros(dim))]})
+
+    def test_param_defaults(self):
+        olr = OnlineLogisticRegression()
+        assert olr.get_alpha() == 0.1
+        assert olr.get_beta() == 0.1
+        assert olr.get_batch_strategy() == "count"
+
+    def test_online_training_improves(self):
+        batches = _classification_batches(30, 32)
+        olr = (
+            OnlineLogisticRegression()
+            .set_global_batch_size(32)
+            .set_initial_model_data(self._initial_model())
+        )
+        model = olr.fit(StreamTable.from_batches(batches))
+        model.process_updates()
+        assert model.model_version == 30
+        test = _classification_batches(1, 200, seed=99)[0]
+        out = model.transform(test)[0]
+        acc = (np.asarray(out.column("prediction")) == np.asarray(test.column("label"))).mean()
+        assert acc > 0.9, acc
+        # model version column attached (OnlineLogisticRegressionModel.java:133)
+        assert np.all(np.asarray(out.column("modelVersion")) == 30)
+
+    def test_version_increments_per_batch(self):
+        olr = (
+            OnlineLogisticRegression()
+            .set_global_batch_size(8)
+            .set_initial_model_data(self._initial_model())
+        )
+        model = olr.fit(StreamTable.from_batches(_classification_batches(3, 8)))
+        versions = []
+        for _ in range(3):
+            model.process_updates(max_batches=1)
+            versions.append(model.model_version)
+        assert versions == [1, 2, 3]
+
+    def test_regularization_sparsifies(self):
+        batches = _classification_batches(20, 32)
+        olr = (
+            OnlineLogisticRegression()
+            .set_global_batch_size(32)
+            .set_reg(2.0)
+            .set_elastic_net(1.0)  # pure l1
+            .set_initial_model_data(self._initial_model())
+        )
+        model = olr.fit(StreamTable.from_batches(batches))
+        model.process_updates()
+        assert np.sum(model.coefficient == 0.0) > 0
+
+    def test_save_load(self, tmp_path):
+        olr = (
+            OnlineLogisticRegression()
+            .set_global_batch_size(8)
+            .set_initial_model_data(self._initial_model())
+        )
+        model = olr.fit(StreamTable.from_batches(_classification_batches(2, 8)))
+        model.process_updates()
+        model.save(str(tmp_path / "olr"))
+        loaded = OnlineLogisticRegressionModel.load(str(tmp_path / "olr"))
+        np.testing.assert_allclose(loaded.coefficient, model.coefficient)
+        assert loaded.model_version == 2
+
+    def test_init_from_batch_lr(self):
+        t = _classification_batches(1, 100)[0]
+        batch_model = LogisticRegression().set_max_iter(10).fit(t)
+        olr = (
+            OnlineLogisticRegression()
+            .set_global_batch_size(16)
+            .set_initial_model_data(batch_model.get_model_data()[0])
+        )
+        model = olr.fit(StreamTable.from_batches(_classification_batches(2, 16)))
+        model.process_updates()
+        assert model.model_version == 2
