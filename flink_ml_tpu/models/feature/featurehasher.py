@@ -1,0 +1,78 @@
+"""FeatureHasher — hashes numeric/categorical columns into one sparse vector.
+
+TPU-native re-design of feature/featurehasher/FeatureHasher.java (guava
+murmur3_32(0) over the column name for numeric columns — value kept as the
+coefficient, summed on collisions — and over "column=value" for categorical
+columns with coefficient 1.0; nonNegativeMod bucketing; numFeatures default
+262144). Hash indices match the reference bit-for-bit via utils/hashing.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...api import Transformer
+from ...common.param import HasCategoricalCols, HasInputCols, HasNumFeatures, HasOutputCol
+from ...table import Table, rows_to_sparse_batch
+from ...utils.hashing import murmur3_hash_unencoded_chars
+
+
+def _hash_index(s: str, num_features: int) -> int:
+    """FeatureHasher.updateMap: Math.abs(hash) then floorMod — including
+    Java's Math.abs(Integer.MIN_VALUE) == MIN_VALUE quirk."""
+    h = murmur3_hash_unencoded_chars(s)
+    h = h if h == -(2**31) else abs(h)
+    return h % num_features
+
+
+class FeatureHasherParams(HasInputCols, HasCategoricalCols, HasOutputCol, HasNumFeatures):
+    pass
+
+
+class FeatureHasher(Transformer, FeatureHasherParams):
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        input_cols = self.get_input_cols()
+        if not input_cols:
+            raise ValueError("Parameter inputCols must be set")
+        categorical = set(self.get_categorical_cols())
+        if not categorical.issubset(input_cols):
+            raise ValueError("CategoricalCols must be included in inputCols!")
+        # string/boolean columns are categorical even when not declared
+        # (FeatureHasher.generateCategoricalCols)
+        for col in input_cols:
+            values = np.asarray(table.column(col))
+            if values.dtype == object or values.dtype.kind in "USb":
+                categorical.add(col)
+        n_features = self.get_num_features()
+        numeric_cols = [c for c in input_cols if c not in categorical]
+        n = table.num_rows
+
+        def java_str(v) -> str:
+            if isinstance(v, (bool, np.bool_)):
+                return "true" if v else "false"
+            return str(v)
+
+        features = [dict() for _ in range(n)]
+        for col in numeric_cols:
+            idx = _hash_index(col, n_features)
+            values = np.asarray(table.column(col), dtype=np.float64)
+            for r in range(n):
+                features[r][idx] = features[r].get(idx, 0.0) + float(values[r])
+        for col in input_cols:
+            if col not in categorical:
+                continue
+            values = table.column(col)
+            for r in range(n):
+                idx = _hash_index(f"{col}={java_str(values[r])}", n_features)
+                features[r][idx] = features[r].get(idx, 0.0) + 1.0
+        row_idx = [sorted(f) for f in features]
+        row_val = [[f[i] for i in keys] for f, keys in zip(features, row_idx)]
+        return [
+            table.with_column(
+                self.get_output_col(),
+                rows_to_sparse_batch(n_features, row_idx, row_val),
+            )
+        ]

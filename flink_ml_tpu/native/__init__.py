@@ -1,0 +1,73 @@
+"""ctypes loader for the native runtime library (native/src/datacache.cc).
+
+Compiles the C++ source with g++ on first use (cached as a .so next to the
+source, keyed by source mtime) — the environment bakes the toolchain but no
+prebuilt artifacts. Falls back to `available() == False` when no compiler
+is present so pure-Python paths keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "src", "datacache.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libdatacache.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _compile() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC],
+        check=True,
+        capture_output=True,
+    )
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u64, p = ctypes.c_uint64, ctypes.c_void_p
+    lib.dc_create.restype = p
+    lib.dc_create.argtypes = [u64, ctypes.c_char_p]
+    lib.dc_destroy.argtypes = [p]
+    lib.dc_append.restype = ctypes.c_long
+    lib.dc_append.argtypes = [p, ctypes.c_void_p, u64]
+    lib.dc_num_segments.restype = ctypes.c_long
+    lib.dc_num_segments.argtypes = [p]
+    lib.dc_segment_size.restype = u64
+    lib.dc_segment_size.argtypes = [p, ctypes.c_long]
+    lib.dc_read.restype = ctypes.c_int
+    lib.dc_read.argtypes = [p, ctypes.c_long, ctypes.c_void_p]
+    lib.dc_memory_used.restype = u64
+    lib.dc_memory_used.argtypes = [p]
+    lib.dc_spilled_segments.restype = ctypes.c_long
+    lib.dc_spilled_segments.argtypes = [p]
+    lib.dc_spilled_bytes.restype = u64
+    lib.dc_spilled_bytes.argtypes = [p]
+    lib.dc_parse_csv_doubles.restype = ctypes.c_long
+    lib.dc_parse_csv_doubles.argtypes = [ctypes.c_char_p, u64, ctypes.c_void_p, u64]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    try:
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            _compile()
+        lib = ctypes.CDLL(_LIB)
+        _declare(lib)
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError) as e:
+        _load_error = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,189 @@
+"""Python surface of the native spillable data cache + replayable streams.
+
+`DataCache` wraps the C++ segment store (native/src/datacache.cc);
+`ReplayableStreamTable` is the ReplayOperator analogue
+(flink-ml-iteration/.../operator/ReplayOperator.java:125-246): the first
+pass over an unbounded input caches every batch through the native cache
+(memory-budgeted, disk-spilled), after which the stream can be re-iterated
+every epoch — exactly what bounded iterations over StreamTable inputs need.
+A pure-numpy fallback keeps behavior identical where no C++ toolchain
+exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import tempfile
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..table import SparseBatch, Table
+from . import load as _load_native
+
+
+class DataCache:
+    """Append-only segment cache with a memory budget and disk spill."""
+
+    def __init__(self, memory_budget_bytes: int = 64 << 20, spill_dir: Optional[str] = None):
+        self._lib = _load_native()
+        self._meta: List[Tuple] = []  # per-segment (dtype, shape)
+        if self._lib is not None:
+            spill_dir = spill_dir or tempfile.gettempdir()
+            self._spill_path = os.path.join(
+                spill_dir, f"flink_ml_tpu_cache_{os.getpid()}_{id(self):x}.bin"
+            )
+            self._handle = self._lib.dc_create(
+                ctypes.c_uint64(memory_budget_bytes), self._spill_path.encode()
+            )
+        else:  # pure-python fallback
+            self._handle = None
+            self._segments: List[bytes] = []
+
+    # -- segments -----------------------------------------------------------
+    def append_array(self, array: np.ndarray) -> int:
+        array = np.ascontiguousarray(array)
+        self._meta.append((array.dtype, array.shape))
+        data = array.tobytes()
+        if self._handle is not None:
+            seg = self._lib.dc_append(self._handle, data, ctypes.c_uint64(len(data)))
+            if seg < 0:
+                raise IOError("native data cache append failed")
+            return int(seg)
+        self._segments.append(data)
+        return len(self._segments) - 1
+
+    def read_array(self, seg: int) -> np.ndarray:
+        dtype, shape = self._meta[seg]
+        if self._handle is not None:
+            size = self._lib.dc_segment_size(self._handle, ctypes.c_long(seg))
+            out = np.empty(size, dtype=np.uint8)
+            rc = self._lib.dc_read(
+                self._handle, ctypes.c_long(seg), out.ctypes.data_as(ctypes.c_void_p)
+            )
+            if rc != 0:
+                raise IOError(f"native data cache read failed with code {rc}")
+            return out.view(dtype).reshape(shape)
+        return np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape)
+
+    @property
+    def num_segments(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.dc_num_segments(self._handle))
+        return len(self._segments)
+
+    @property
+    def spilled_segments(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.dc_spilled_segments(self._handle))
+        return 0
+
+    @property
+    def memory_used(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.dc_memory_used(self._handle))
+        return sum(len(s) for s in self._segments)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dc_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def parse_csv_doubles(text: str, expected: Optional[int] = None) -> np.ndarray:
+    """Fast float64 parsing of delimited numeric text via the native strtod
+    loop; falls back to numpy.fromstring-style parsing without the lib."""
+    lib = _load_native()
+    raw = text.encode()
+    max_out = expected if expected is not None else max(1, len(raw) // 2 + 1)
+    if lib is not None:
+        out = np.empty(max_out, dtype=np.float64)
+        n = lib.dc_parse_csv_doubles(
+            raw, ctypes.c_uint64(len(raw)),
+            out.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint64(max_out),
+        )
+        return out[:n]
+    # strtod-compatible fallback: parse the longest leading float of each
+    # token, skipping tokens with no numeric prefix
+    import re
+
+    number = re.compile(r"[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
+    values = []
+    for t in text.replace(",", " ").replace(";", " ").split():
+        m = number.match(t)
+        if m:
+            values.append(float(m.group(0)))
+    return np.asarray(values[:max_out], dtype=np.float64)
+
+
+class ReplayableStreamTable:
+    """Caches a one-shot batch stream so it can be replayed every epoch
+    (ReplayOperator.java semantics)."""
+
+    def __init__(self, batches, memory_budget_bytes: int = 64 << 20,
+                 spill_dir: Optional[str] = None):
+        self._source = iter(batches)
+        self._cache = DataCache(memory_budget_bytes, spill_dir)
+        self._schemas: List[Dict] = []  # per batch: {col: (kind, seg ids)}
+        self._exhausted = False
+
+    def _cache_batch(self, table: Table) -> None:
+        schema = {}
+        for name in table.column_names:
+            col = table.column(name)
+            if isinstance(col, SparseBatch):
+                schema[name] = (
+                    "sparse",
+                    col.size,
+                    self._cache.append_array(col.indices),
+                    self._cache.append_array(col.values),
+                )
+            else:
+                arr = np.asarray(col)
+                if arr.dtype == object:
+                    raise TypeError(
+                        f"Column {name!r} holds python objects; only numeric "
+                        "and sparse columns can be cached natively"
+                    )
+                schema[name] = ("dense", self._cache.append_array(arr))
+        self._schemas.append(schema)
+
+    def _restore_batch(self, schema: Dict) -> Table:
+        cols = {}
+        for name, spec in schema.items():
+            if spec[0] == "sparse":
+                _, size, seg_i, seg_v = spec
+                cols[name] = SparseBatch(
+                    size, self._cache.read_array(seg_i), self._cache.read_array(seg_v)
+                )
+            else:
+                cols[name] = self._cache.read_array(spec[1])
+        return Table(cols)
+
+    def __iter__(self) -> Iterator[Table]:
+        # Every pass starts from the beginning: replay what is already
+        # cached, then keep consuming the source — a partially-consumed
+        # first pass (early stop, zip with a shorter stream) still leaves
+        # later passes complete.
+        for schema in list(self._schemas):
+            yield self._restore_batch(schema)
+        if not self._exhausted:
+            for table in self._source:
+                self._cache_batch(table)
+                yield table
+            self._exhausted = True
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {
+            "numSegments": self._cache.num_segments,
+            "spilledSegments": self._cache.spilled_segments,
+            "memoryUsedBytes": self._cache.memory_used,
+        }

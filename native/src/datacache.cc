@@ -1,0 +1,173 @@
+// Spillable columnar data cache — the native runtime component of the
+// framework's IO layer.
+//
+// TPU-native re-design of the reference's datacache subsystem
+// (flink-ml-iteration/.../datacache/nonkeyed/: DataCacheWriter.java:37-153,
+// MemorySegmentWriter.java, FileSegmentWriter.java, DataCacheReader.java,
+// Segment.java, ListStateWithCache.java): append-only segments live in
+// memory until a budget is exhausted, then spill to an append-only file;
+// reads are position-addressed and zero-copy into caller buffers. Exposed
+// through a C ABI consumed via ctypes (flink_ml_tpu/native/__init__.py).
+//
+// Build: g++ -O2 -shared -fPIC -o libdatacache.so datacache.cc
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Segment {
+  // exactly one of: memory-resident bytes, or a [offset, size) span of the
+  // cache's spill file (Segment.java holds MemorySegments or a spilled path)
+  std::vector<uint8_t> bytes;
+  bool spilled = false;
+  uint64_t file_offset = 0;
+  uint64_t size = 0;
+};
+
+struct DataCache {
+  std::mutex mu;
+  std::vector<Segment> segments;
+  uint64_t memory_budget;
+  uint64_t memory_used = 0;
+  uint64_t spilled_bytes = 0;
+  long spilled_segments = 0;
+  std::string spill_path;
+  FILE* spill_file = nullptr;  // lazily created append-only spill store
+};
+
+bool ensure_spill_file(DataCache* dc) {
+  if (dc->spill_file != nullptr) return true;
+  dc->spill_file = std::fopen(dc->spill_path.c_str(), "w+b");
+  return dc->spill_file != nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dc_create(uint64_t memory_budget_bytes, const char* spill_path) {
+  auto* dc = new DataCache();
+  dc->memory_budget = memory_budget_bytes;
+  dc->spill_path = spill_path ? spill_path : "";
+  return dc;
+}
+
+void dc_destroy(void* handle) {
+  auto* dc = static_cast<DataCache*>(handle);
+  if (dc->spill_file != nullptr) {
+    std::fclose(dc->spill_file);
+    std::remove(dc->spill_path.c_str());
+  }
+  delete dc;
+}
+
+// Appends one segment; returns its id, or -1 on failure.
+long dc_append(void* handle, const void* data, uint64_t nbytes) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  Segment seg;
+  seg.size = nbytes;
+  if (dc->memory_used + nbytes <= dc->memory_budget || dc->spill_path.empty()) {
+    // memory-resident (MemorySegmentWriter path)
+    seg.bytes.assign(static_cast<const uint8_t*>(data),
+                     static_cast<const uint8_t*>(data) + nbytes);
+    dc->memory_used += nbytes;
+  } else {
+    // spill (FileSegmentWriter path)
+    if (!ensure_spill_file(dc)) return -1;
+    if (std::fseek(dc->spill_file, 0, SEEK_END) != 0) return -1;
+    long pos = std::ftell(dc->spill_file);
+    if (pos < 0) return -1;
+    if (std::fwrite(data, 1, nbytes, dc->spill_file) != nbytes) return -1;
+    std::fflush(dc->spill_file);
+    seg.spilled = true;
+    seg.file_offset = static_cast<uint64_t>(pos);
+    dc->spilled_bytes += nbytes;
+    dc->spilled_segments += 1;
+  }
+  dc->segments.push_back(std::move(seg));
+  return static_cast<long>(dc->segments.size()) - 1;
+}
+
+long dc_num_segments(void* handle) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  return static_cast<long>(dc->segments.size());
+}
+
+// Size in bytes of segment `seg`, or 0 if out of range.
+uint64_t dc_segment_size(void* handle, long seg) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  if (seg < 0 || seg >= static_cast<long>(dc->segments.size())) return 0;
+  return dc->segments[seg].size;
+}
+
+// Copies segment `seg` into `out` (caller allocates dc_segment_size bytes).
+// Returns 0 on success.
+int dc_read(void* handle, long seg, void* out) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  if (seg < 0 || seg >= static_cast<long>(dc->segments.size())) return 1;
+  const Segment& s = dc->segments[seg];
+  if (!s.spilled) {
+    std::memcpy(out, s.bytes.data(), s.size);
+    return 0;
+  }
+  if (std::fseek(dc->spill_file, static_cast<long>(s.file_offset), SEEK_SET) != 0)
+    return 2;
+  if (std::fread(out, 1, s.size, dc->spill_file) != s.size) return 3;
+  return 0;
+}
+
+uint64_t dc_memory_used(void* handle) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  return dc->memory_used;
+}
+
+long dc_spilled_segments(void* handle) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  return dc->spilled_segments;
+}
+
+uint64_t dc_spilled_bytes(void* handle) {
+  auto* dc = static_cast<DataCache*>(handle);
+  std::lock_guard<std::mutex> lock(dc->mu);
+  return dc->spilled_bytes;
+}
+
+// Fast float64 CSV/whitespace parser: fills `out` with up to max_out values
+// parsed from text[0..len); returns the number parsed. Commas, semicolons,
+// whitespace and newlines all delimit.
+long dc_parse_csv_doubles(const char* text, uint64_t len, double* out,
+                          uint64_t max_out) {
+  uint64_t count = 0;
+  const char* p = text;
+  const char* end = text + len;
+  while (p < end && count < max_out) {
+    while (p < end && (*p == ',' || *p == ';' || *p == ' ' || *p == '\t' ||
+                       *p == '\n' || *p == '\r'))
+      ++p;
+    if (p >= end) break;
+    char* next = nullptr;
+    double value = std::strtod(p, &next);
+    if (next == p) {  // unparsable token: skip it
+      while (p < end && !(*p == ',' || *p == ';' || *p == ' ' || *p == '\t' ||
+                          *p == '\n' || *p == '\r'))
+        ++p;
+      continue;
+    }
+    out[count++] = value;
+    p = next;
+  }
+  return static_cast<long>(count);
+}
+
+}  // extern "C"

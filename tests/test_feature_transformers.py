@@ -340,3 +340,34 @@ class TestRandomSplitter:
     def test_invalid_weights(self):
         with pytest.raises(ValueError):
             RandomSplitter().set_weights(1.0)
+
+
+class TestFeatureHasher:
+    def test_golden_values(self):
+        # FeatureHasherTest.java INPUT_DATA / EXPECTED_OUTPUT_DATA
+        t = Table(
+            {
+                "f0": np.asarray(["a", "c"], dtype=object),
+                "f1": [1.0, 1.0],
+                "f2": np.asarray(["true", "false"], dtype=object),
+            }
+        )
+        from flink_ml_tpu.models.feature.featurehasher import FeatureHasher
+
+        out = (
+            FeatureHasher()
+            .set_input_cols("f0", "f1", "f2")
+            .set_categorical_cols("f0", "f2")
+            .set_num_features(1000)
+        ).transform(t)[0]
+        batch = out.column("output")
+        np.testing.assert_array_equal(batch.row(0).indices, [607, 635, 913])
+        np.testing.assert_array_equal(batch.row(0).values, [1, 1, 1])
+        np.testing.assert_array_equal(batch.row(1).indices, [242, 869, 913])
+
+    def test_numeric_value_kept(self):
+        from flink_ml_tpu.models.feature.featurehasher import FeatureHasher
+
+        t = Table({"x": [2.5]})
+        out = FeatureHasher().set_input_cols("x").set_num_features(100).transform(t)[0]
+        assert out.column("output").row(0).values[0] == 2.5

@@ -1,0 +1,96 @@
+"""Native data-cache battery — mirrors the reference's
+DataCacheWriteReadTest.java / DataCacheSnapshotTest.java /
+ReplayOperatorTest.java shapes: segment roundtrips, spill-under-budget,
+replayable streams."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.native import available
+from flink_ml_tpu.native.datacache import (
+    DataCache,
+    ReplayableStreamTable,
+    parse_csv_doubles,
+)
+from flink_ml_tpu.table import SparseBatch, Table
+
+
+def test_native_library_builds():
+    assert available(), "g++ toolchain expected in this environment"
+
+
+def test_append_read_roundtrip():
+    cache = DataCache(memory_budget_bytes=1 << 20)
+    arrays = [
+        np.arange(100, dtype=np.float64).reshape(10, 10),
+        np.asarray([1, -2, 3], dtype=np.int32),
+        np.random.RandomState(0).rand(5, 7).astype(np.float32),
+    ]
+    segs = [cache.append_array(a) for a in arrays]
+    for seg, a in zip(segs, arrays):
+        got = cache.read_array(seg)
+        assert got.dtype == a.dtype and got.shape == a.shape
+        np.testing.assert_array_equal(got, a)
+    assert cache.num_segments == 3
+    cache.close()
+
+
+def test_spill_when_over_budget(tmp_path):
+    cache = DataCache(memory_budget_bytes=1024, spill_dir=str(tmp_path))
+    small = np.zeros(64, dtype=np.float64)  # 512 bytes
+    big = np.arange(512, dtype=np.float64)  # 4096 bytes -> must spill
+    s1 = cache.append_array(small)
+    s2 = cache.append_array(big)
+    s3 = cache.append_array(big * 2)
+    assert cache.spilled_segments >= 2
+    assert cache.memory_used <= 1024
+    np.testing.assert_array_equal(cache.read_array(s1), small)
+    np.testing.assert_array_equal(cache.read_array(s2), big)
+    np.testing.assert_array_equal(cache.read_array(s3), big * 2)
+    cache.close()
+
+
+def test_replayable_stream(tmp_path):
+    batches = [
+        Table({"x": np.random.RandomState(i).rand(50, 4), "y": np.arange(50, dtype=np.float64)})
+        for i in range(3)
+    ]
+    replay = ReplayableStreamTable(iter(batches), memory_budget_bytes=1 << 10,
+                                  spill_dir=str(tmp_path))
+    first = [np.asarray(t.column("x")).copy() for t in replay]
+    assert len(first) == 3
+    assert replay.stats["spilledSegments"] > 0  # tiny budget forces spill
+    # second and third passes replay from the cache
+    for _ in range(2):
+        second = [np.asarray(t.column("x")) for t in replay]
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_replayable_sparse_columns():
+    sb = SparseBatch(10, [[0, 3], [1, -1]], [[1.0, 2.0], [3.0, 0.0]])
+    replay = ReplayableStreamTable(iter([Table({"s": sb})]))
+    list(replay)
+    (restored,) = list(replay)
+    got = restored.column("s")
+    np.testing.assert_array_equal(got.indices, sb.indices)
+    np.testing.assert_array_equal(got.values, sb.values)
+
+
+def test_object_columns_rejected():
+    t = Table({"words": np.asarray([["a"], ["b"]], dtype=object)})
+    replay = ReplayableStreamTable(iter([t]))
+    with pytest.raises(TypeError):
+        list(replay)
+
+
+def test_parse_csv_doubles():
+    got = parse_csv_doubles("1.5, 2.25\n-3e2; 4,abc,5.5")
+    np.testing.assert_array_equal(got, [1.5, 2.25, -300.0, 4.0, 5.5])
+
+
+def test_parse_csv_performance_smoke():
+    text = ",".join(str(float(i)) for i in range(100_000))
+    got = parse_csv_doubles(text, expected=100_000)
+    assert got.shape == (100_000,)
+    np.testing.assert_allclose(got[:5], [0, 1, 2, 3, 4])
