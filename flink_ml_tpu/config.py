@@ -1,0 +1,34 @@
+"""Runtime configuration knobs.
+
+The analogue of the reference's Flink ConfigOptions — a single option there
+too (`iteration.data-cache.path`, config/IterationOptions.java:30-37).
+`iteration_checkpoint_dir` enables epoch-boundary checkpoint/resume of
+iterative training (SGD); estimators pick it up process-wide, as Flink jobs
+pick up cluster configuration.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+iteration_checkpoint_dir: Optional[str] = None
+iteration_checkpoint_interval: int = 1
+
+
+def set_iteration_checkpoint_dir(path: Optional[str], interval: int = 1) -> None:
+    global iteration_checkpoint_dir, iteration_checkpoint_interval
+    iteration_checkpoint_dir = path
+    iteration_checkpoint_interval = interval
+
+
+@contextmanager
+def iteration_checkpointing(path: str, interval: int = 1):
+    """Scoped checkpoint/resume for iterative training."""
+    global iteration_checkpoint_dir, iteration_checkpoint_interval
+    prev = (iteration_checkpoint_dir, iteration_checkpoint_interval)
+    iteration_checkpoint_dir, iteration_checkpoint_interval = path, interval
+    try:
+        yield
+    finally:
+        iteration_checkpoint_dir, iteration_checkpoint_interval = prev

@@ -40,7 +40,10 @@ def extract_train_data(
 
 def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]):
     """Wire a Has*-param stage into the SGD optimizer; returns
-    (coefficient, final_loss, num_epochs)."""
+    (coefficient, final_loss, num_epochs). Checkpoint/resume follows the
+    process-wide `config.iteration_checkpoint_dir`."""
+    from .. import config
+
     X, y, w = extract_train_data(
         table, params.get_features_col(), params.get_label_col(), weight_col
     )
@@ -51,6 +54,8 @@ def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]
         tol=params.get_tol(),
         reg=params.get_reg(),
         elastic_net=params.get_elastic_net(),
+        checkpoint_dir=config.iteration_checkpoint_dir,
+        checkpoint_interval=config.iteration_checkpoint_interval,
     )
     init_coeff = np.zeros(X.shape[1], dtype=np.float64)
     return optimizer.optimize(init_coeff, X, y, w, loss_func)

@@ -116,9 +116,32 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
     return coeff, criteria, epochs
 
 
+@partial(jax.jit, static_argnames=("loss_func",))
+def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
+    """One host-driven epoch: apply the previous gradient, compute the next.
+    Same math as one `_sgd_train` while-loop step — used when checkpointing
+    needs epoch-boundary control on the host."""
+    coeff, grad, wsum, epoch = carry
+    num_batches = X_b.shape[0]
+    coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    k = jnp.mod(epoch, num_batches)
+    Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
+    yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
+    wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
+    lsum, grad, wsum = loss_func(Xk, yk, wk, coeff)
+    criteria = lsum / jnp.maximum(wsum, 1e-30)
+    return (coeff, grad, wsum, epoch + 1), jnp.asarray(criteria, jnp.float32)
+
+
 @dataclass
 class SGD:
-    """Parallel mini-batch SGD (common/optimizer/SGD.java)."""
+    """Parallel mini-batch SGD (common/optimizer/SGD.java).
+
+    With `checkpoint_dir` set, training runs one jitted epoch per host step
+    and snapshots (coeff, grad, wsum, epoch, criteria) at epoch boundaries
+    (`checkpoint_interval`), resuming from the snapshot if one exists — the
+    synchronous-SPMD simplification of the reference's feedback-edge
+    checkpointing (SURVEY.md §5: epoch boundary = consistent state)."""
 
     max_iter: int = 20
     learning_rate: float = 0.1
@@ -127,6 +150,14 @@ class SGD:
     reg: float = 0.0
     elastic_net: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 1
+    shard_features: bool = False
+    """Also shard the feature dimension over the mesh `model` axis — the
+    tensor-parallel layout for wide (e.g. sparse-Criteo-dim) models
+    (SURVEY.md §2.3: feature-sharded linear training as the TP analogue).
+    The X@coeff contraction then all-reduces over `model` while the
+    gradient contraction all-reduces over `data`; both ride ICI."""
 
     def optimize(
         self,
@@ -139,12 +170,29 @@ class SGD:
     ) -> Tuple[np.ndarray, float, int]:
         """Returns (final_coefficient, final_loss, num_epochs)."""
         mesh = mesh or mesh_lib.default_mesh()
+        d = np.shape(X)[1]
+        if self.shard_features:
+            # zero-pad the feature dim to divide over the model axis; padded
+            # coefficients start 0, get zero gradients, and stay 0
+            model_shards = int(mesh.shape.get(mesh_lib.MODEL_AXIS, 1))
+            d_pad = -(-d // model_shards) * model_shards
+            if d_pad != d:
+                X = np.pad(np.asarray(X), [(0, 0), (0, d_pad - d)])
+                init_coeff = np.pad(np.asarray(init_coeff), (0, d_pad - d))
         X_b, y_b, w_b = self._batchify(mesh, X, y, weights)
+        init = np.asarray(init_coeff, self.dtype)
+        if self.shard_features:
+            init = jax.device_put(init, mesh_lib.model_sharding(mesh))
+        if self.checkpoint_dir is not None:
+            coeff, criteria, epochs = self._optimize_with_checkpoints(
+                X_b, y_b, w_b, init, loss_func
+            )
+            return coeff[:d], criteria, epochs
         coeff, criteria, epochs = _sgd_train(
             X_b,
             y_b,
             w_b,
-            jnp.asarray(init_coeff, self.dtype),
+            jnp.asarray(init, self.dtype),
             loss_func,
             jnp.asarray(self.max_iter, jnp.int32),
             jnp.asarray(self.tol, jnp.float32),
@@ -152,7 +200,37 @@ class SGD:
             jnp.asarray(self.reg, self.dtype),
             jnp.asarray(self.elastic_net, self.dtype),
         )
-        return np.asarray(coeff), float(criteria), int(epochs)
+        return np.asarray(coeff)[:d], float(criteria), int(epochs)
+
+    def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func):
+        from ..parallel.iteration import (
+            load_iteration_checkpoint,
+            save_iteration_checkpoint,
+        )
+
+        d = X_b.shape[-1]
+        lr = jnp.asarray(self.learning_rate, self.dtype)
+        reg = jnp.asarray(self.reg, self.dtype)
+        en = jnp.asarray(self.elastic_net, self.dtype)
+        carry = (
+            jnp.asarray(init_coeff, self.dtype),
+            jnp.zeros((d,), self.dtype),
+            jnp.asarray(0.0, self.dtype),
+            jnp.asarray(0, jnp.int32),
+        )
+        epoch, criteria = 0, float("inf")
+        restored = load_iteration_checkpoint(self.checkpoint_dir, carry)
+        if restored is not None:
+            carry, epoch, criteria = restored
+        while epoch < self.max_iter and criteria > self.tol:
+            carry, crit = _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, en)
+            criteria = float(crit)
+            epoch += 1
+            if epoch % self.checkpoint_interval == 0:
+                save_iteration_checkpoint(self.checkpoint_dir, carry, epoch, criteria)
+        coeff, grad, wsum, _ = carry
+        coeff = _update_model(coeff, grad, wsum, lr, reg, en)
+        return np.asarray(coeff), criteria, epoch
 
     def _batchify(self, mesh: Mesh, X, y, weights):
         """Pad + reshape host data into device-resident
@@ -180,7 +258,10 @@ class SGD:
             if b_pad != B:
                 widths = [(0, 0), (0, b_pad - B)] + [(0, 0)] * (arr.ndim - 2)
                 arr = np.pad(arr, widths, constant_values=pad_value)
-            spec = P(None, mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 2)))
+            if self.shard_features and arr.ndim == 3:
+                spec = P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+            else:
+                spec = P(None, mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 2)))
             return jax.device_put(arr, NamedSharding(mesh, spec))
 
         # Padding rows get weight 0: they contribute nothing to loss/grad/weight.
