@@ -19,6 +19,49 @@ from ..common.param import HasSeed
 from ..param import IntParam, LongParam, Param, ParamValidators
 from ..table import Table
 
+# Rows at or above this threshold are generated directly in device HBM with
+# jax.random — the analogue of the reference generating data *inside* the
+# cluster (InputTableGenerator.java runs as a Flink source, not a client
+# upload). Below it, numpy keeps tiny test tables host-side and cheap.
+# NOTE: the two paths draw from different RNGs, so a fixed seed yields
+# different values (and float32 vs float64) across the threshold. Set
+# FLINK_ML_TPU_DEVICE_DATAGEN=0 to force the numpy path at every size when
+# cross-size seeded reproducibility matters more than ingest speed.
+DEVICE_GEN_THRESHOLD = 65_536
+
+
+def _device_gen_enabled() -> bool:
+    import os
+
+    return os.environ.get("FLINK_ML_TPU_DEVICE_DATAGEN", "1") != "0"
+
+
+_device_gen_fns = {}
+
+
+def _device_uniform(seed: int, shape):
+    import jax
+
+    if "uniform" not in _device_gen_fns:  # one compiled program per shape
+        _device_gen_fns["uniform"] = jax.jit(
+            lambda key, shape: jax.random.uniform(key, shape, dtype=jax.numpy.float32),
+            static_argnames=("shape",),
+        )
+    return _device_gen_fns["uniform"](jax.random.PRNGKey(seed), tuple(shape))
+
+
+def _device_randint_float(seed: int, shape, arity: int):
+    import jax
+
+    if "randint" not in _device_gen_fns:
+        _device_gen_fns["randint"] = jax.jit(
+            lambda key, shape, arity: jax.random.randint(key, shape, 0, arity).astype(
+                jax.numpy.float32
+            ),
+            static_argnames=("shape", "arity"),
+        )
+    return _device_gen_fns["randint"](jax.random.PRNGKey(seed), tuple(shape), int(arity))
+
 
 class _ColNamesParam(Param):
     """String[][] colNames (InputDataGenerator.java COL_NAMES)."""
@@ -70,7 +113,11 @@ class DenseVectorGenerator(DataGenerator):
 
     def get_data(self) -> List[Table]:
         (names,) = self.get_col_names()
-        X = self._rng().rand(self.get_num_values(), self.get_vector_dim())
+        n, d = self.get_num_values(), self.get_vector_dim()
+        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+            X = _device_uniform(self.get_seed() % (2**32), (n, d))
+        else:
+            X = self._rng().rand(n, d)
         return [Table({names[0]: X})]
 
 
@@ -142,9 +189,18 @@ class LabeledPointWithWeightGenerator(DataGenerator):
 
     def get_data(self) -> List[Table]:
         (names,) = self.get_col_names()
-        rng = self._rng()
         n, d = self.get_num_values(), self.get_vector_dim()
         arity = self.get_feature_arity()
+        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+            seed = self.get_seed() % (2**32)
+            if arity == 0:
+                X = _device_uniform(seed, (n, d))
+            else:
+                X = _device_randint_float(seed, (n, d), arity)
+            y = _device_randint_float(seed + 1, (n,), self.get_label_arity())
+            w = _device_uniform(seed + 2, (n,))
+            return [Table({names[0]: X, names[1]: y, names[2]: w})]
+        rng = self._rng()
         if arity == 0:
             X = rng.rand(n, d)
         else:

@@ -28,14 +28,24 @@ def extract_train_data(
     label_col: Optional[str],
     weight_col: Optional[str],
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
-    X = as_dense_matrix(table.column(features_col))
+    X = as_dense_matrix(table.column(features_col), allow_device=True)
     y = None
     if label_col is not None:
-        y = np.asarray(table.column(label_col), dtype=np.float64)
+        y = _as_host_or_device_vector(table.column(label_col))
     w = None
     if weight_col is not None:
-        w = np.asarray(table.column(weight_col), dtype=np.float64)
+        w = _as_host_or_device_vector(table.column(weight_col))
     return X, y, w
+
+
+def _as_host_or_device_vector(col):
+    """Device-resident columns stay on device; host columns become float64
+    numpy (the SGD engine casts once to its compute dtype on transfer)."""
+    import jax
+
+    if isinstance(col, jax.Array):
+        return col
+    return np.asarray(col, dtype=np.float64)
 
 
 def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]):
@@ -61,10 +71,18 @@ def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]
     return optimizer.optimize(init_coeff, X, y, w, loss_func)
 
 
-def validate_binomial_labels(y: np.ndarray) -> None:
+def validate_binomial_labels(y) -> None:
     """The reference only supports {0, 1} labels for binary linear
-    classifiers (LogisticRegression.java:78-87)."""
-    if not np.all((y == 0.0) | (y == 1.0)):
+    classifiers (LogisticRegression.java:78-87). Device-resident labels are
+    validated on device (one scalar readback, no bulk transfer)."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(y, jax.Array):
+        ok = bool(jnp.all((y == 0.0) | (y == 1.0)))
+    else:
+        ok = bool(np.all((y == 0.0) | (y == 1.0)))
+    if not ok:
         raise ValueError(
             "Multinomial classification is not supported yet. "
             "Supported options: [auto, binomial]."

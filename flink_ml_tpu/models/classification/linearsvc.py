@@ -117,8 +117,7 @@ class LinearSVC(Estimator, LinearSVCParams):
 
     def fit(self, *inputs: Table) -> LinearSVCModel:
         (table,) = inputs
-        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
-        _linear.validate_binomial_labels(y)
+        _linear.validate_binomial_labels(table.column(self.get_label_col()))
         coeff, _, _ = _linear.run_sgd(self, table, HINGE_LOSS, self.get_weight_col())
         model = LinearSVCModel()
         model.coefficient = coeff

@@ -87,16 +87,17 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
+        device_in = isinstance(X, jax.Array)
         pred, raw = _predict(jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32))
-        return [
-            table.with_columns(
-                {
-                    self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
-                    self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
-                }
-            )
-        ]
+        if device_in:  # device data in -> device predictions out, no D2H
+            cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
+        else:
+            cols = {
+                self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
+                self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
+            }
+        return [table.with_columns(cols)]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, coefficient=self.coefficient)
@@ -115,8 +116,7 @@ class LogisticRegression(Estimator, LogisticRegressionParams):
                 "Multinomial classification is not supported yet. "
                 "Supported options: [auto, binomial]."
             )
-        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
-        _linear.validate_binomial_labels(y)
+        _linear.validate_binomial_labels(table.column(self.get_label_col()))
         coeff, _, _ = _linear.run_sgd(
             self, table, BINARY_LOGISTIC_LOSS, self.get_weight_col()
         )

@@ -43,6 +43,51 @@ from ..parallel import mesh as mesh_lib
 from .losses import LossFunc
 
 
+def _layout_batches_impl(arr, n, num_batches, batch, b_pad, d_pad, sharding):
+    """Device-side batch layout: strip any staging pad beyond the true row
+    count n, pad rows to num_batches*batch, reshape to
+    (num_batches, batch, ...), pad the per-batch axis to b_pad (divisible
+    over the data shards) and optionally the feature axis to d_pad, then
+    constrain to the training sharding. Runs entirely in HBM — the host
+    never copies the dataset (the round-1 host re-layout at ~30 MB/s was
+    the training bottleneck)."""
+    if arr.shape[0] != n:
+        arr = arr[:n]
+    pad_rows = num_batches * batch - n
+    if pad_rows:
+        arr = jnp.pad(arr, [(0, pad_rows)] + [(0, 0)] * (arr.ndim - 1))
+    arr = arr.reshape((num_batches, batch) + arr.shape[1:])
+    if b_pad != batch:
+        arr = jnp.pad(arr, [(0, 0), (0, b_pad - batch)] + [(0, 0)] * (arr.ndim - 2))
+    if d_pad is not None and d_pad != arr.shape[-1]:
+        arr = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(0, d_pad - arr.shape[-1])])
+    return lax.with_sharding_constraint(arr, sharding)
+
+
+_LAYOUT_STATICS = ("n", "num_batches", "batch", "b_pad", "d_pad", "sharding")
+# Borrowed variant for caller-owned buffers (device-born Table columns);
+# donating variant for buffers _batchify staged itself — donation lets XLA
+# free the flat copy during layout, halving peak HBM for the dataset.
+_layout_batches = jax.jit(_layout_batches_impl, static_argnames=_LAYOUT_STATICS)
+_layout_batches_donating = jax.jit(
+    _layout_batches_impl, static_argnames=_LAYOUT_STATICS, donate_argnums=(0,)
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "num_batches", "batch", "b_pad", "dtype", "sharding"),
+)
+def _default_weights(n, num_batches, batch, b_pad, dtype, sharding):
+    """Unit weights for the first n rows, 0 for padding — generated on
+    device so the default-weight case transfers nothing."""
+    idx = jnp.arange(num_batches * batch)
+    w = (idx < n).astype(dtype).reshape(num_batches, batch)
+    if b_pad != batch:
+        w = jnp.pad(w, [(0, 0), (0, b_pad - batch)])
+    return lax.with_sharding_constraint(w, sharding)
+
+
 def regularize(coeff, reg, elastic_net, learning_rate):
     """Proximal regularization step; returns (new_coeff, reg_loss).
 
@@ -177,9 +222,10 @@ class SGD:
             model_shards = int(mesh.shape.get(mesh_lib.MODEL_AXIS, 1))
             d_pad = -(-d // model_shards) * model_shards
             if d_pad != d:
-                X = np.pad(np.asarray(X), [(0, 0), (0, d_pad - d)])
                 init_coeff = np.pad(np.asarray(init_coeff), (0, d_pad - d))
-        X_b, y_b, w_b = self._batchify(mesh, X, y, weights)
+        else:
+            d_pad = None
+        X_b, y_b, w_b = self._batchify(mesh, X, y, weights, d_pad)
         init = np.asarray(init_coeff, self.dtype)
         if self.shard_features:
             init = jax.device_put(init, mesh_lib.model_sharding(mesh))
@@ -232,37 +278,86 @@ class SGD:
         coeff = _update_model(coeff, grad, wsum, lr, reg, en)
         return np.asarray(coeff), criteria, epoch
 
-    def _batchify(self, mesh: Mesh, X, y, weights):
-        """Pad + reshape host data into device-resident
-        (num_batches, padded_batch, ...) arrays sharded over the data axis."""
-        X = np.asarray(X, dtype=self.dtype)
-        y = np.asarray(y, dtype=self.dtype)
-        n = X.shape[0]
-        w = (
-            np.ones(n, dtype=self.dtype)
-            if weights is None
-            else np.asarray(weights, dtype=self.dtype)
-        )
+    def _batchify(self, mesh: Mesh, X, y, weights, d_pad=None):
+        """Stage data into device-resident (num_batches, padded_batch, ...)
+        arrays sharded over the data axis.
+
+        Host inputs make exactly ONE flat host→device transfer each (dtype
+        cast is the only host copy, and only when needed); device-resident
+        inputs (e.g. benchmark tables generated on chip) transfer nothing.
+        All padding/reshaping happens on device (`_layout_batches`), and
+        absent weights are synthesized on device (`_default_weights`)."""
+        n = int(np.shape(X)[0])
         B = int(self.global_batch_size)
         num_batches = max(1, -(-n // B))
-        n_pad = num_batches * B
         shards = mesh_lib.num_data_shards(mesh)
         b_pad = -(-B // shards) * shards
 
-        def prep(arr, pad_value=0.0):
-            pad_rows = n_pad - arr.shape[0]
-            if pad_rows:
-                widths = [(0, pad_rows)] + [(0, 0)] * (arr.ndim - 1)
-                arr = np.pad(arr, widths, constant_values=pad_value)
-            arr = arr.reshape((num_batches, B) + arr.shape[1:])
-            if b_pad != B:
-                widths = [(0, 0), (0, b_pad - B)] + [(0, 0)] * (arr.ndim - 2)
-                arr = np.pad(arr, widths, constant_values=pad_value)
-            if self.shard_features and arr.ndim == 3:
-                spec = P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
-            else:
-                spec = P(None, mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 2)))
-            return jax.device_put(arr, NamedSharding(mesh, spec))
+        def stage(arr):
+            """One flat transfer, row-sharded across the mesh so no single
+            chip stages the whole dataset; cast to self.dtype with minimal
+            host work (halves bytes on the wire for f64 input). Host rows
+            are zero-padded to a shard-divisible count; `_layout_batches`
+            strips that pad via the true n. Returns (array, owned): owned
+            buffers were created here and may be donated to the layout."""
+            if isinstance(arr, jax.Array):
+                if arr.dtype != self.dtype:
+                    return arr.astype(self.dtype), True
+                return arr, False
+            arr = np.asarray(arr)
+            if arr.dtype != self.dtype:
+                arr = arr.astype(self.dtype)
+            spec = P(mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 1)))
+            sharding = NamedSharding(mesh, spec)
+            rows = arr.shape[0]
+            if shards == 1 or rows % shards == 0:
+                return jax.device_put(arr, sharding), True
+            n_stage = -(-rows // shards) * shards
 
-        # Padding rows get weight 0: they contribute nothing to loss/grad/weight.
-        return prep(X), prep(y), prep(w, pad_value=0.0)
+            def shard_chunk(index):
+                rs = index[0]
+                start = rs.start or 0
+                stop = rs.stop if rs.stop is not None else n_stage
+                if stop <= rows:  # whole chunk is real data: zero-copy view
+                    chunk = arr[start:stop]
+                else:  # tail chunk: copy valid rows into a zero pad block
+                    chunk = np.zeros((stop - start,) + arr.shape[1:], arr.dtype)
+                    if start < rows:
+                        chunk[: rows - start] = arr[start:rows]
+                return chunk[(slice(None),) + tuple(index[1:])]
+
+            return (
+                jax.make_array_from_callback(
+                    (n_stage,) + arr.shape[1:], sharding, shard_chunk
+                ),
+                True,
+            )
+
+        def layout(staged, *args):
+            arr, owned = staged
+            fn = _layout_batches_donating if owned else _layout_batches
+            return fn(arr, *args)
+
+        X_b = layout(
+            stage(X),
+            n,
+            num_batches,
+            B,
+            b_pad,
+            d_pad,
+            NamedSharding(
+                mesh,
+                P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+                if d_pad is not None
+                else P(None, mesh_lib.DATA_AXIS, None),
+            ),
+        )
+        row_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
+        y_b = layout(stage(y), n, num_batches, B, b_pad, None, row_sharding)
+        if weights is None:
+            # Padding rows get weight 0: they contribute nothing to
+            # loss/grad/weight sums.
+            w_b = _default_weights(n, num_batches, B, b_pad, self.dtype, row_sharding)
+        else:
+            w_b = layout(stage(weights), n, num_batches, B, b_pad, None, row_sharding)
+        return X_b, y_b, w_b

@@ -256,11 +256,26 @@ class StreamTable:
         return StreamTable(list(batches))
 
 
-def as_dense_matrix(col) -> np.ndarray:
+def as_dense_matrix(col, allow_device: bool = False) -> np.ndarray:
     """Coerce a features column to a dense (n, d) float array. float32 input
-    stays float32 (no 2x host-memory upcast on the 10M-row benchmark path)."""
+    stays float32 (no 2x host-memory upcast on the 10M-row benchmark path).
+
+    With `allow_device=True`, device-resident (jax) columns pass through
+    untouched — no host round trip on the device-born benchmark data path.
+    Callers that opt in must treat the result as immutable (jax arrays
+    don't support in-place assignment); the default converts to numpy so
+    mutating transformers keep working on device tables."""
     if isinstance(col, SparseBatch):
         return col.to_dense()
+    try:
+        import jax
+
+        if isinstance(col, jax.Array):
+            if allow_device:
+                return col if col.ndim > 1 else col[:, None]
+            col = np.asarray(col)
+    except ImportError:  # pragma: no cover
+        pass
     arr = col
     if isinstance(arr, np.ndarray) and arr.dtype == object:
         from .linalg import vectors_to_dense_batch
