@@ -209,8 +209,14 @@ class MinHashLSH(Estimator, MinHashLSHParams):
             )
         num_fns = self.get_num_hash_tables() * self.get_num_hash_functions_per_table()
         rng = JavaRandom(self.get_seed())
-        a = np.asarray([1 + rng.next_int(HASH_PRIME - 1) for _ in range(num_fns)], dtype=np.int64)
-        b = np.asarray([rng.next_int(HASH_PRIME - 1) for _ in range(num_fns)], dtype=np.int64)
+        # a[i] then b[i] interleaved from one stream, matching
+        # MinHashLSHModelData.generateModelData's per-iteration draw order
+        # (seed-for-seed model parity with reference-written models).
+        a = np.empty(num_fns, dtype=np.int64)
+        b = np.empty(num_fns, dtype=np.int64)
+        for i in range(num_fns):
+            a[i] = 1 + rng.next_int(HASH_PRIME - 1)
+            b[i] = rng.next_int(HASH_PRIME - 1)
         model = MinHashLSHModel()
         model.rand_coefficient_a = a
         model.rand_coefficient_b = b

@@ -10,7 +10,9 @@ values are indexed via their string form, as in the reference.
 
 from __future__ import annotations
 
+import math
 from collections import Counter
+from decimal import Decimal
 from typing import List
 
 import numpy as np
@@ -29,11 +31,43 @@ ALPHABET_DESC_ORDER = "alphabetDesc"
 ALPHABET_ASC_ORDER = "alphabetAsc"
 
 
+def _java_double_to_string(v: float) -> str:
+    """Java Double.toString semantics: decimal form for 1e-3 <= |v| < 1e7,
+    otherwise d.dddE±x scientific form (e.g. '1.0E7', '1.0E-4'), with
+    'NaN'/'Infinity'/'0.0' specials. Needed so numeric columns index
+    identically to reference-written StringIndexer models.
+
+    Known limit: digits come from Python's shortest round-trip repr; the
+    legacy (pre-JDK19) FloatingDecimal occasionally emits non-shortest
+    digits (e.g. Double.MIN_VALUE prints '4.9E-324' there, '5.0E-324'
+    here). Only subnormal-magnitude keys are affected."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    sign = "-" if (v < 0 or (v == 0 and math.copysign(1.0, v) < 0)) else ""
+    a = abs(v)
+    if a == 0:
+        return sign + "0.0"
+    if 1e-3 <= a < 1e7:
+        s = repr(a)
+        if "." not in s:
+            s += ".0"
+        return sign + s
+    dec = Decimal(repr(a))
+    _, digits, dexp = dec.as_tuple()
+    ds = "".join(map(str, digits))
+    exp = len(ds) - 1 + dexp
+    ds = ds.rstrip("0") or "0"
+    frac = ds[1:] or "0"
+    return f"{sign}{ds[0]}.{frac}E{exp}"
+
+
 def _to_string(value) -> str:
     if isinstance(value, str):
         return value
     if isinstance(value, (float, np.floating)):
-        return repr(float(value))
+        return _java_double_to_string(float(value))
     return str(value)
 
 

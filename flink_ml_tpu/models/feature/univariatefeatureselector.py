@@ -107,9 +107,12 @@ def select_indices_from_p_values(
     if mode == FPR:
         return np.nonzero(p_values < threshold)[0]
     if mode == FDR:
-        # Benjamini-Hochberg: largest k with p_(k) <= k/d * alpha.
+        # Benjamini-Hochberg: largest k with p_(k) < (alpha/d)*k — strict
+        # comparison AND this exact operand order, matching
+        # UnivariateFeatureSelector.java:236-238 bit for bit on boundary
+        # p-values ((alpha/d)*k can differ from (k/d)*alpha by 1 ulp).
         sorted_p = p_values[order]
-        ks = np.nonzero(sorted_p <= (np.arange(1, d + 1) / d) * threshold)[0]
+        ks = np.nonzero(sorted_p < (threshold / d) * np.arange(1, d + 1))[0]
         if ks.size == 0:
             return np.asarray([], dtype=np.int64)
         return np.sort(order[: ks[-1] + 1])

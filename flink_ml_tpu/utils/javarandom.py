@@ -44,4 +44,8 @@ class JavaRandom:
     def next_long(self) -> int:
         hi = self._next(32)
         lo = self._next(32)
-        return (hi << 32) + lo
+        # Wrap to signed 64-bit the way Java overflow does (hi =
+        # Integer.MIN_VALUE with negative lo would otherwise escape the
+        # long range as an unbounded Python int).
+        v = ((hi << 32) + lo) & ((1 << 64) - 1)
+        return v - (1 << 64) if v >= (1 << 63) else v

@@ -146,6 +146,27 @@ class TestStringIndexer:
     def _table(self):
         return Table({"f1": ["a", "b", "b", "c"], "f2": [2.0, 1.0, 1.0, 3.0]})
 
+    def test_java_double_to_string(self):
+        """Numeric keys format like Java Double.toString so models written
+        by the reference index identically (scientific form outside
+        [1e-3, 1e7), StringIndexer.java uses String.valueOf)."""
+        from flink_ml_tpu.models.feature.stringindexer import _java_double_to_string as f
+
+        assert f(1.0) == "1.0"
+        assert f(-2.5) == "-2.5"
+        assert f(0.001) == "0.001"
+        assert f(9999999.0) == "9999999.0"
+        assert f(1e7) == "1.0E7"
+        assert f(12345678.0) == "1.2345678E7"
+        assert f(1e-4) == "1.0E-4"
+        assert f(-1.5e-5) == "-1.5E-5"
+        assert f(0.0) == "0.0"
+        assert f(-0.0) == "-0.0"
+        assert f(float("nan")) == "NaN"
+        assert f(float("inf")) == "Infinity"
+        assert f(float("-inf")) == "-Infinity"
+        assert f(1.23456789e100) == "1.23456789E100"
+
     def test_alphabet_asc(self):
         model = (
             StringIndexer()
@@ -380,6 +401,21 @@ class TestUnivariateFeatureSelector:
         out = np.asarray(model.transform(t)[0].column("output"))
         np.testing.assert_allclose(out[:, 0], X[:, 2])
 
+    def test_fdr_cutoff_is_strict(self):
+        """BH cutoff uses strict < (UnivariateFeatureSelector.java:236-237):
+        a p-value exactly equal to k/d * alpha is NOT selected."""
+        from flink_ml_tpu.models.feature.univariatefeatureselector import (
+            select_indices_from_p_values,
+        )
+
+        # d=4, alpha=0.4: cutoffs are 0.1, 0.2, 0.3, 0.4
+        p = np.asarray([0.1, 0.5, 0.6, 0.7])  # p_(1) == 1/4*0.4 exactly
+        assert select_indices_from_p_values(p, "fdr", 0.4).size == 0
+        p = np.asarray([0.0999, 0.5, 0.6, 0.7])  # strictly below
+        np.testing.assert_array_equal(
+            select_indices_from_p_values(p, "fdr", 0.4), [0]
+        )
+
     def test_fpr_chisq(self):
         rng = np.random.RandomState(1)
         y = np.repeat([0.0, 1.0], 100)
@@ -432,6 +468,45 @@ class TestMinHashLSH:
         a1 = self._model().rand_coefficient_a
         a2 = self._model().rand_coefficient_a
         np.testing.assert_array_equal(a1, a2)
+
+    def test_reference_golden_hashes(self):
+        """Seed-for-seed parity with the reference: fitted at seed 2022 with
+        5 tables x 3 functions, transform must reproduce MinHashLSHTest's
+        outputRows exactly (MinHashLSHTest.java:61-83; the reference
+        compares unordered, so we sort both sides)."""
+        expected = [
+            [[1.73046954e8, 1.57275425e8, 6.90717571e8],
+             [5.02301169e8, 7.967141e8, 4.06089319e8],
+             [2.83652171e8, 1.97714719e8, 6.04731316e8],
+             [5.2181506e8, 6.36933726e8, 6.13894128e8],
+             [3.04301769e8, 1.113672955e9, 6.1388711e8]],
+            [[1.73046954e8, 1.57275425e8, 6.7798584e7],
+             [6.38582806e8, 1.78703694e8, 4.06089319e8],
+             [6.232638e8, 9.28867e7, 9.92010642e8],
+             [2.461064e8, 1.12787481e8, 1.92180297e8],
+             [2.38162496e8, 1.552933319e9, 2.77995137e8]],
+            [[1.73046954e8, 1.57275425e8, 6.90717571e8],
+             [1.453197722e9, 7.967141e8, 4.06089319e8],
+             [6.232638e8, 1.97714719e8, 6.04731316e8],
+             [2.461064e8, 1.12787481e8, 1.92180297e8],
+             [1.224130231e9, 1.113672955e9, 2.77995137e8]],
+        ]
+        out = self._model_5x3().transform(self._table())[0]
+        got = sorted(
+            tuple(map(tuple, np.asarray([np.asarray(x) for x in h])))
+            for h in out.column("hashes")
+        )
+        assert got == sorted(tuple(map(tuple, e)) for e in expected)
+
+    def _model_5x3(self):
+        return (
+            MinHashLSH()
+            .set_input_col("vec")
+            .set_output_col("hashes")
+            .set_seed(2022)
+            .set_num_hash_tables(5)
+            .set_num_hash_functions_per_table(3)
+        ).fit(self._table())
 
     def test_nearest_neighbors(self):
         model = self._model()

@@ -1,0 +1,50 @@
+"""java.util.Random stream-compatibility tests (utils/javarandom.py).
+
+Golden values are the publicly documented outputs of java.util.Random's
+specified 48-bit LCG (e.g. new Random(0).nextLong()).
+"""
+
+import numpy as np
+
+from flink_ml_tpu.utils.javarandom import JavaRandom
+
+
+def test_next_int_golden():
+    r = JavaRandom(0)
+    assert r.next_int() == -1155484576  # new Random(0).nextInt()
+    assert r.next_int() == -723955400
+
+
+def test_next_int_bounded_regression():
+    # Regression pins for the rejection-sampling bounded path; the bounded
+    # path's Java-parity is independently proven by the MinHashLSH golden
+    # test (reference-generated hashes reproduce exactly from next_int(bound)).
+    r = JavaRandom(42)
+    assert [r.next_int(100) for _ in range(4)] == [30, 63, 48, 84]
+
+
+def test_next_long_golden():
+    assert JavaRandom(0).next_long() == -4962768465676381896  # documented value
+
+
+def test_next_long_wraps_to_signed_64():
+    """hi == Integer.MIN_VALUE with negative lo overflows Java's long and
+    wraps; the Python port must wrap identically instead of growing an
+    unbounded int."""
+
+    class Stub(JavaRandom):
+        def __init__(self, values):
+            self._values = list(values)
+
+        def _next(self, bits):
+            return self._values.pop(0)
+
+    v = Stub([-(1 << 31), -1]).next_long()
+    assert v == (1 << 63) - 1  # Java: (-2^63) + (-1) wraps to Long.MAX_VALUE
+    assert -(1 << 63) <= v < (1 << 63)
+
+
+def test_next_double_range():
+    r = JavaRandom(7)
+    xs = np.asarray([r.next_double() for _ in range(100)])
+    assert np.all((xs >= 0.0) & (xs < 1.0))
