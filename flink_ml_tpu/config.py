@@ -15,6 +15,12 @@ from typing import Optional
 iteration_checkpoint_dir: Optional[str] = None
 iteration_checkpoint_interval: int = 1
 
+# Spillable data-cache defaults for training on StreamTable inputs (the
+# analogue of `iteration.data-cache.path` + managed-memory weights in the
+# reference). Batches beyond the in-memory budget spill to disk segments.
+datacache_memory_budget_bytes: int = 64 << 20
+datacache_spill_dir: Optional[str] = None
+
 
 def set_iteration_checkpoint_dir(path: Optional[str], interval: int = 1) -> None:
     global iteration_checkpoint_dir, iteration_checkpoint_interval

@@ -48,15 +48,25 @@ def _as_host_or_device_vector(col):
     return np.asarray(col, dtype=np.float64)
 
 
-def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]):
+def run_sgd(
+    params,
+    table,
+    loss_func: LossFunc,
+    weight_col: Optional[str],
+    validate_binomial: bool = False,
+):
     """Wire a Has*-param stage into the SGD optimizer; returns
     (coefficient, final_loss, num_epochs). Checkpoint/resume follows the
-    process-wide `config.iteration_checkpoint_dir`."""
-    from .. import config
+    process-wide `config.iteration_checkpoint_dir`.
 
-    X, y, w = extract_train_data(
-        table, params.get_features_col(), params.get_label_col(), weight_col
-    )
+    A bounded `Table` trains in-memory/device-resident; a `StreamTable`
+    trains out-of-core through the native spillable data cache
+    (cache-then-replay, the ReplayOperator contract — SGD.optimize_stream)
+    with an identical batch schedule, so both paths produce the same
+    coefficients for the same data."""
+    from .. import config
+    from ..table import StreamTable
+
     optimizer = SGD(
         max_iter=params.get_max_iter(),
         learning_rate=params.get_learning_rate(),
@@ -67,8 +77,33 @@ def run_sgd(params, table: Table, loss_func: LossFunc, weight_col: Optional[str]
         checkpoint_dir=config.iteration_checkpoint_dir,
         checkpoint_interval=config.iteration_checkpoint_interval,
     )
+    if isinstance(table, StreamTable):
+        chunks = _stream_chunks(
+            table,
+            params.get_features_col(),
+            params.get_label_col(),
+            weight_col,
+            validate_binomial,
+        )
+        coeff, loss, epochs, _ = optimizer.optimize_stream(None, chunks, loss_func)
+        return coeff, loss, epochs
+    if validate_binomial:
+        validate_binomial_labels(table.column(params.get_label_col()))
+    X, y, w = extract_train_data(
+        table, params.get_features_col(), params.get_label_col(), weight_col
+    )
     init_coeff = np.zeros(X.shape[1], dtype=np.float64)
     return optimizer.optimize(init_coeff, X, y, w, loss_func)
+
+
+def _stream_chunks(stream, features_col, label_col, weight_col, validate_binomial):
+    """Yield (X, y, w) host chunks from a StreamTable's mini-batch Tables,
+    validating labels per batch when asked."""
+    for batch in stream:
+        X, y, w = extract_train_data(batch, features_col, label_col, weight_col)
+        if validate_binomial:
+            validate_binomial_labels(y)
+        yield np.asarray(X), np.asarray(y), None if w is None else np.asarray(w)
 
 
 def validate_binomial_labels(y) -> None:

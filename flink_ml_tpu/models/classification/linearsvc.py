@@ -117,8 +117,9 @@ class LinearSVC(Estimator, LinearSVCParams):
 
     def fit(self, *inputs: Table) -> LinearSVCModel:
         (table,) = inputs
-        _linear.validate_binomial_labels(table.column(self.get_label_col()))
-        coeff, _, _ = _linear.run_sgd(self, table, HINGE_LOSS, self.get_weight_col())
+        coeff, _, _ = _linear.run_sgd(
+            self, table, HINGE_LOSS, self.get_weight_col(), validate_binomial=True
+        )
         model = LinearSVCModel()
         model.coefficient = coeff
         update_existing_params(model, self)

@@ -116,9 +116,9 @@ class LogisticRegression(Estimator, LogisticRegressionParams):
                 "Multinomial classification is not supported yet. "
                 "Supported options: [auto, binomial]."
             )
-        _linear.validate_binomial_labels(table.column(self.get_label_col()))
         coeff, _, _ = _linear.run_sgd(
-            self, table, BINARY_LOGISTIC_LOSS, self.get_weight_col()
+            self, table, BINARY_LOGISTIC_LOSS, self.get_weight_col(),
+            validate_binomial=True,
         )
         model = LogisticRegressionModel()
         model.coefficient = coeff

@@ -96,6 +96,7 @@ class KMeansModel(Model, KMeansModelParams):
     def __init__(self):
         self.centroids: np.ndarray = None  # (k, d)
         self.weights: np.ndarray = None  # (k,)
+        self.cache_stats = None  # set by out-of-core (StreamTable) fits
 
     def set_model_data(self, *inputs: Table) -> "KMeansModel":
         (model_data,) = inputs
@@ -141,9 +142,41 @@ class KMeansModel(Model, KMeansModelParams):
         self.centroids, self.weights = arrays["centroids"], arrays["weights"]
 
 
+@partial(jax.jit, static_argnames=("measure_name",))
+def _accumulate_batch(X, w, centroids, measure_name):
+    """Per-batch Lloyd accumulation for out-of-core training: assign each
+    row to its closest centroid and return (sums, counts) partials that the
+    host adds across the replayed stream. w masks shard-padding rows."""
+    measure = DistanceMeasure.get_instance(measure_name)
+    dists = measure.pairwise(X, centroids)
+    assign = jnp.argmin(dists, axis=1)
+    one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype) * w[:, None]
+    return one_hot.T @ X, jnp.sum(one_hot, axis=0)
+
+
+def _sample_without_replacement(rng: np.random.RandomState, n: int, k: int) -> np.ndarray:
+    """Seeded k-of-n sample. Below the threshold this is exactly the
+    in-memory path's rng.choice draw (stream/in-memory init parity); above
+    it, rejection sampling avoids RandomState.choice's O(n) permutation
+    (16 GB of indices at n=2e9 — the scale this path exists for)."""
+    if n <= 10_000_000:
+        return rng.choice(n, size=k, replace=False)
+    seen, out = set(), []
+    while len(out) < k:
+        v = int(rng.randint(0, n))
+        if v not in seen:
+            seen.add(v)
+            out.append(v)
+    return np.asarray(out, dtype=np.int64)
+
+
 class KMeans(Estimator, KMeansParams):
-    def fit(self, *inputs: Table) -> KMeansModel:
+    def fit(self, *inputs) -> KMeansModel:
         (table,) = inputs
+        from ...table import StreamTable
+
+        if isinstance(table, StreamTable):
+            return self._fit_stream(table)
         mesh = mesh_lib.default_mesh()
         X_host = np.asarray(
             as_dense_matrix(table.column(self.get_features_col())), dtype=np.float32
@@ -177,4 +210,87 @@ class KMeans(Estimator, KMeansParams):
         model.centroids = np.asarray(centroids, dtype=np.float64)
         model.weights = np.asarray(counts, dtype=np.float64)
         update_existing_params(model, self)
+        return model
+
+    def _fit_stream(self, stream) -> KMeansModel:
+        """Out-of-core Lloyd over a StreamTable: the first pass caches every
+        batch through the native spillable data cache (cache-then-replay,
+        ReplayOperator.java:125-246), later epochs replay the cached stream
+        with only one batch in HBM at a time. Initialization matches the
+        in-memory path exactly: the same seeded global-row-index sample
+        (selectRandomCentroids, KMeans.java:310) fetched back from the
+        cache, so a stream fit reproduces an in-memory fit of the
+        concatenated stream."""
+        from ... import config
+        from ...native.datacache import ReplayableStreamTable
+
+        replay = (
+            stream
+            if isinstance(stream, ReplayableStreamTable)
+            else ReplayableStreamTable(
+                stream,
+                config.datacache_memory_budget_bytes,
+                config.datacache_spill_dir,
+            )
+        )
+        col = self.get_features_col()
+        k = self.get_k()
+
+        batch_rows = []
+        for t in replay:  # pass 0: cache + count
+            batch_rows.append(t.num_rows)
+        n = int(np.sum(batch_rows, dtype=np.int64)) if batch_rows else 0
+        if n < k:
+            raise ValueError(f"Number of points ({n}) is less than k ({k})")
+
+        rng = np.random.RandomState(self.get_seed() % (2**32))
+        centroid_idx = _sample_without_replacement(rng, n, k)  # in-memory order
+        needed = np.sort(centroid_idx)
+        bounds = np.cumsum([0] + batch_rows)
+        picked = {}
+        for bi, t in enumerate(replay):
+            lo, hi = bounds[bi], bounds[bi + 1]
+            if lo > needed[-1]:
+                break  # every sampled row already fetched — skip the tail
+            local = needed[(needed >= lo) & (needed < hi)] - lo
+            if local.size:
+                X = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
+                for li in local:
+                    picked[int(li + lo)] = X[li]
+        init = np.stack([picked[int(i)] for i in centroid_idx])
+
+        mesh = mesh_lib.default_mesh()
+        shards = mesh_lib.num_data_shards(mesh)
+        mat_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
+        row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        centroids = jnp.asarray(init)
+        measure = self.get_distance_measure()
+        for _ in range(self.get_max_iter()):
+            sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
+            counts = jnp.zeros((k,), jnp.float32)
+            for t in replay:
+                X = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
+                rows = X.shape[0]
+                X_pad, _ = mesh_lib.pad_to_multiple(X, shards)
+                w = np.zeros(X_pad.shape[0], np.float32)
+                w[:rows] = 1.0
+                s, c = _accumulate_batch(
+                    jax.device_put(X_pad, mat_sharding),
+                    jax.device_put(w, row_sharding),
+                    centroids,
+                    measure,
+                )
+                sums = sums + s
+                counts = counts + c
+            centroids = jnp.where(
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts[:, None], 1e-30),
+                centroids,
+            )
+
+        model = KMeansModel()
+        model.centroids = np.asarray(centroids, dtype=np.float64)
+        model.weights = np.asarray(counts, dtype=np.float64)
+        update_existing_params(model, self)
+        model.cache_stats = replay.stats
         return model

@@ -140,14 +140,14 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
 
     def body(state):
         coeff, grad, wsum, epoch, _ = state
-        coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
         k = jnp.mod(epoch, num_batches)
         Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
         yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
         wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
-        lsum, grad, wsum = loss_func(Xk, yk, wk, coeff)
-        criteria = lsum / jnp.maximum(wsum, 1e-30)
-        return (coeff, grad, wsum, epoch + 1, jnp.asarray(criteria, jnp.float32))
+        carry, criteria = _epoch_step(
+            Xk, yk, wk, (coeff, grad, wsum, epoch), loss_func, lr, reg, elastic_net
+        )
+        return carry + (criteria,)
 
     init_state = (
         jnp.asarray(init_coeff, dtype),
@@ -161,21 +161,36 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
     return coeff, criteria, epochs
 
 
-@partial(jax.jit, static_argnames=("loss_func",))
-def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
-    """One host-driven epoch: apply the previous gradient, compute the next.
-    Same math as one `_sgd_train` while-loop step — used when checkpointing
-    needs epoch-boundary control on the host."""
+def _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
+    """The single-epoch math shared by every driver (`_sgd_train` body,
+    host-driven checkpointing epochs, out-of-core stream epochs): apply the
+    previous gradient, compute the next on this epoch's batch. One
+    definition keeps the documented stream/in-memory coefficient parity a
+    structural fact rather than three copies to keep in sync."""
     coeff, grad, wsum, epoch = carry
-    num_batches = X_b.shape[0]
     coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
-    k = jnp.mod(epoch, num_batches)
-    Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
-    yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
-    wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
     lsum, grad, wsum = loss_func(Xk, yk, wk, coeff)
     criteria = lsum / jnp.maximum(wsum, 1e-30)
     return (coeff, grad, wsum, epoch + 1), jnp.asarray(criteria, jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("loss_func",))
+def _stream_epoch(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
+    """Out-of-core epoch: the batch arrives as an argument (read back from
+    the spillable data cache) instead of being indexed out of a resident
+    (num_batches, B, d) array — only one batch ever occupies HBM."""
+    return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
+
+
+@partial(jax.jit, static_argnames=("loss_func",))
+def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
+    """One host-driven epoch over resident batched data — used when
+    checkpointing needs epoch-boundary control on the host."""
+    k = jnp.mod(carry[3], X_b.shape[0])
+    Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
+    yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
+    wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
+    return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
 
 
 @dataclass
@@ -247,6 +262,155 @@ class SGD:
             jnp.asarray(self.elastic_net, self.dtype),
         )
         return np.asarray(coeff)[:d], float(criteria), int(epochs)
+
+    def optimize_stream(
+        self,
+        init_coeff: Optional[np.ndarray],
+        chunks,
+        loss_func: LossFunc,
+        mesh: Optional[Mesh] = None,
+        memory_budget_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+    ):
+        """Out-of-core SGD over a one-shot stream of (X, y, w) host chunks.
+
+        The cache-then-replay contract of the reference's ReplayOperator
+        (flink-ml-iteration/.../operator/ReplayOperator.java:125-246) +
+        spillable DataCache (datacache/nonkeyed/DataCacheWriter.java): the
+        single pass over the stream re-chunks rows into globalBatchSize
+        batches and appends them to the native spillable cache; every epoch
+        then replays its batch from the cache. Only one batch is resident
+        in HBM at a time, so datasets larger than device memory (and, with
+        spill, larger than host memory budget) train fine.
+
+        Batch schedule and padding match `optimize` exactly, so a stream
+        fit produces the same coefficients as an in-memory fit of the
+        concatenated stream. Returns (final_coefficient, final_loss,
+        num_epochs, cache_stats)."""
+        from .. import config
+        from ..native.datacache import DataCache
+
+        if self.shard_features:
+            raise NotImplementedError(
+                "feature-sharded (tensor-parallel) training requires the "
+                "in-memory path; stream mode is data-parallel only"
+            )
+        mesh = mesh or mesh_lib.default_mesh()
+        B = int(self.global_batch_size)
+        shards = mesh_lib.num_data_shards(mesh)
+        b_pad = -(-B // shards) * shards
+        cache = DataCache(
+            memory_budget_bytes
+            if memory_budget_bytes is not None
+            else config.datacache_memory_budget_bytes,
+            spill_dir if spill_dir is not None else config.datacache_spill_dir,
+        )
+        segs = []  # per batch: (seg_X, seg_y, seg_w)
+        pend = None  # carried remainder rows (X, y, w)
+        d = None
+
+        def emit(Xb, yb, wb):
+            """Pad a B-row batch to b_pad with weight-0 rows and cache it."""
+            if b_pad != Xb.shape[0]:
+                extra = b_pad - Xb.shape[0]
+                Xb = np.pad(Xb, [(0, extra), (0, 0)])
+                yb = np.pad(yb, (0, extra))
+                wb = np.pad(wb, (0, extra))
+            segs.append(
+                (
+                    cache.append_array(Xb),
+                    cache.append_array(yb),
+                    cache.append_array(wb),
+                )
+            )
+
+        for chunk in chunks:
+            X, y, w = chunk
+            X = np.asarray(X, self.dtype)
+            y = np.asarray(y, self.dtype)
+            w = (
+                np.ones(X.shape[0], self.dtype)
+                if w is None
+                else np.asarray(w, self.dtype)
+            )
+            d = X.shape[1] if d is None else d
+            if pend is not None:
+                X = np.concatenate([pend[0], X])
+                y = np.concatenate([pend[1], y])
+                w = np.concatenate([pend[2], w])
+                pend = None
+            off = 0
+            while X.shape[0] - off >= B:
+                emit(X[off : off + B], y[off : off + B], w[off : off + B])
+                off += B
+            if off < X.shape[0]:
+                pend = (X[off:], y[off:], w[off:])
+        if pend is not None:
+            Xr, yr, wr = pend
+            extra = B - Xr.shape[0]
+            emit(
+                np.pad(Xr, [(0, extra), (0, 0)]),
+                np.pad(yr, (0, extra)),
+                np.pad(wr, (0, extra)),
+            )
+        if not segs:
+            raise ValueError("optimize_stream received an empty stream")
+        if init_coeff is None:
+            init_coeff = np.zeros(d, self.dtype)
+
+        row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        mat_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
+        lr = jnp.asarray(self.learning_rate, self.dtype)
+        reg = jnp.asarray(self.reg, self.dtype)
+        en = jnp.asarray(self.elastic_net, self.dtype)
+        carry = (
+            jnp.asarray(init_coeff, self.dtype),
+            jnp.zeros((d,), self.dtype),
+            jnp.asarray(0.0, self.dtype),
+            jnp.asarray(0, jnp.int32),
+        )
+        epoch, criteria = 0, float("inf")
+        if self.checkpoint_dir is not None:
+            from ..parallel.iteration import load_iteration_checkpoint
+
+            restored = load_iteration_checkpoint(self.checkpoint_dir, carry)
+            if restored is not None:
+                carry, epoch, criteria = restored
+        nb = len(segs)
+        last_k, batch_dev = None, None
+        try:
+            while epoch < self.max_iter and criteria > self.tol:
+                k = epoch % nb
+                if k != last_k:  # nb == 1 reads/uploads the batch only once
+                    sX, sy, sw = segs[k]
+                    batch_dev = (
+                        jax.device_put(cache.read_array(sX), mat_sharding),
+                        jax.device_put(cache.read_array(sy), row_sharding),
+                        jax.device_put(cache.read_array(sw), row_sharding),
+                    )
+                    last_k = k
+                carry, crit = _stream_epoch(*batch_dev, carry, loss_func, lr, reg, en)
+                criteria = float(crit)
+                epoch += 1
+                if (
+                    self.checkpoint_dir is not None
+                    and epoch % self.checkpoint_interval == 0
+                ):
+                    from ..parallel.iteration import save_iteration_checkpoint
+
+                    save_iteration_checkpoint(
+                        self.checkpoint_dir, carry, epoch, criteria
+                    )
+            coeff, grad, wsum, _ = carry
+            coeff = _update_model(coeff, grad, wsum, lr, reg, en)
+            stats = {
+                "numSegments": cache.num_segments,
+                "spilledSegments": cache.spilled_segments,
+                "memoryUsedBytes": cache.memory_used,
+            }
+        finally:
+            cache.close()
+        return np.asarray(coeff), criteria, epoch, stats
 
     def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func):
         from ..parallel.iteration import (

@@ -1,0 +1,160 @@
+"""Out-of-core training through the native spillable data cache.
+
+The reference replays cached data into bounded iterations
+(ReplayOperator.java:125-246) backed by the spillable DataCacheWriter
+(datacache/nonkeyed/). Here: an Estimator fed a StreamTable caches the one
+pass and replays per epoch (SGD.optimize_stream, KMeans._fit_stream), with
+only one batch resident on device — the larger-than-memory story.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import config
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.clustering.kmeans import KMeans
+from flink_ml_tpu.models.regression.linearregression import LinearRegression
+from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+from flink_ml_tpu.ops.optimizer import SGD
+from flink_ml_tpu.table import StreamTable, Table
+
+
+def _make_data(n=512, d=7, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    truth = rng.standard_normal(d).astype(np.float32)
+    y = (X @ truth > 0).astype(np.float32)
+    return X, y
+
+
+def _chunked_stream(X, y, chunk, weight=None):
+    batches = []
+    for i in range(0, X.shape[0], chunk):
+        cols = {"features": X[i : i + chunk], "label": y[i : i + chunk]}
+        if weight is not None:
+            cols["weight"] = weight[i : i + chunk]
+        batches.append(Table(cols))
+    return StreamTable.from_batches(batches)
+
+
+class TestStreamSGD:
+    def test_stream_fit_matches_in_memory(self, mesh8):
+        """LR fitted from a StreamTable == LR fitted from the concatenated
+        Table (identical batch schedule through the cache)."""
+        X, y = _make_data()
+        lr = lambda: LogisticRegression().set_max_iter(15).set_global_batch_size(100)  # noqa: E731
+        in_mem = lr().fit(Table({"features": X, "label": y}))
+        # chunk size 96 deliberately misaligned with batch size 100
+        streamed = lr().fit(_chunked_stream(X, y, chunk=96))
+        np.testing.assert_allclose(
+            streamed.coefficient, in_mem.coefficient, rtol=1e-6, atol=1e-7
+        )
+
+    def test_stream_fit_with_weights(self, mesh8):
+        X, y = _make_data(seed=3)
+        w = np.random.default_rng(4).random(X.shape[0]).astype(np.float32)
+        table = Table({"features": X, "label": y, "weight": w})
+        est = lambda: (  # noqa: E731
+            LogisticRegression()
+            .set_max_iter(10)
+            .set_global_batch_size(128)
+            .set_weight_col("weight")
+        )
+        in_mem = est().fit(table)
+        streamed = est().fit(_chunked_stream(X, y, chunk=200, weight=w))
+        np.testing.assert_allclose(
+            streamed.coefficient, in_mem.coefficient, rtol=1e-6, atol=1e-7
+        )
+
+    def test_linear_regression_stream(self, mesh8):
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((300, 4)).astype(np.float32)
+        y = (X @ np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)).astype(np.float32)
+        est = lambda: LinearRegression().set_max_iter(30).set_global_batch_size(64)  # noqa: E731
+        in_mem = est().fit(Table({"features": X, "label": y}))
+        streamed = est().fit(
+            _chunked_stream(X, y, chunk=77)
+        )
+        np.testing.assert_allclose(
+            streamed.coefficient, in_mem.coefficient, rtol=1e-6, atol=1e-7
+        )
+
+    def test_forced_spill_during_training(self, mesh8, tmp_path):
+        """A memory budget far below the dataset size forces disk spill;
+        training still matches the in-memory fit."""
+        X, y = _make_data(n=2048, d=16, seed=6)
+        sgd = SGD(max_iter=12, learning_rate=0.1, global_batch_size=256, tol=0.0)
+        chunks = [(X[i : i + 256], y[i : i + 256], None) for i in range(0, 2048, 256)]
+        coeff, _, epochs, stats = sgd.optimize_stream(
+            None,
+            iter(chunks),
+            BINARY_LOGISTIC_LOSS,
+            memory_budget_bytes=4096,  # << dataset (2048*16*4 bytes)
+            spill_dir=str(tmp_path),
+        )
+        assert epochs == 12
+        assert stats["spilledSegments"] > 0, stats
+        ref, _, _ = SGD(
+            max_iter=12, learning_rate=0.1, global_batch_size=256, tol=0.0
+        ).optimize(np.zeros(16, np.float32), X, y, None, BINARY_LOGISTIC_LOSS)
+        np.testing.assert_allclose(coeff, ref, rtol=1e-6, atol=1e-7)
+
+    def test_binomial_validation_per_chunk(self, mesh8):
+        X, y = _make_data(n=64)
+        y = y.copy()
+        y[40] = 3.0  # bad label in the second chunk
+        with pytest.raises(ValueError, match="binomial"):
+            LogisticRegression().set_max_iter(2).set_global_batch_size(32).fit(
+                _chunked_stream(X, y, chunk=32)
+            )
+
+    def test_empty_stream_raises(self, mesh8):
+        with pytest.raises(ValueError, match="empty stream"):
+            SGD().optimize_stream(None, iter([]), BINARY_LOGISTIC_LOSS)
+
+    def test_shard_features_rejected(self, mesh8):
+        with pytest.raises(NotImplementedError):
+            SGD(shard_features=True).optimize_stream(
+                None, iter([]), BINARY_LOGISTIC_LOSS
+            )
+
+
+class TestStreamKMeans:
+    def test_stream_fit_matches_in_memory(self, mesh8):
+        rng = np.random.default_rng(1)
+        X = np.vstack(
+            [rng.standard_normal((100, 5)) + c * 4 for c in range(3)]
+        ).astype(np.float32)
+        est = lambda: KMeans().set_k(3).set_seed(11).set_max_iter(8)  # noqa: E731
+        in_mem = est().fit(Table({"features": X}))
+        batches = [
+            Table({"features": X[i : i + 64]}) for i in range(0, X.shape[0], 64)
+        ]
+        streamed = est().fit(StreamTable.from_batches(batches))
+        np.testing.assert_allclose(
+            streamed.centroids, in_mem.centroids, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(streamed.weights, in_mem.weights)
+
+    def test_stream_fit_spills(self, mesh8, tmp_path):
+        prev = (config.datacache_memory_budget_bytes, config.datacache_spill_dir)
+        config.datacache_memory_budget_bytes = 2048
+        config.datacache_spill_dir = str(tmp_path)
+        try:
+            rng = np.random.default_rng(2)
+            X = rng.standard_normal((600, 8)).astype(np.float32)
+            batches = [
+                Table({"features": X[i : i + 100]}) for i in range(0, 600, 100)
+            ]
+            model = (
+                KMeans().set_k(4).set_seed(3).set_max_iter(3)
+            ).fit(StreamTable.from_batches(batches))
+            assert model.cache_stats["spilledSegments"] > 0, model.cache_stats
+            assert model.centroids.shape == (4, 8)
+        finally:
+            config.datacache_memory_budget_bytes, config.datacache_spill_dir = prev
+
+    def test_fewer_points_than_k(self, mesh8):
+        batches = [Table({"features": np.zeros((2, 3), np.float32)})]
+        with pytest.raises(ValueError, match="less than k"):
+            KMeans().set_k(5).fit(StreamTable.from_batches(batches))
