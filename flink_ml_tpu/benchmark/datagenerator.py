@@ -145,12 +145,31 @@ class DenseVectorArrayGenerator(DenseVectorGenerator):
 
 
 class DoubleGenerator(DataGenerator):
-    """Random uniform doubles (common/DoubleGenerator.java)."""
+    """Random doubles (common/DoubleGenerator.java): uniform [0,1) by
+    default; with arity > 0, integer-valued doubles in [0, arity)."""
+
+    ARITY = IntParam(
+        "arity",
+        "Arity of the generated values: 0 means continuous in [0, 1).",
+        0,
+        ParamValidators.gt_eq(0),
+    )
+
+    def get_arity(self) -> int:
+        return self.get(self.ARITY)
+
+    def set_arity(self, value: int):
+        return self.set(self.ARITY, value)
 
     def get_data(self) -> List[Table]:
         (names,) = self.get_col_names()
         rng = self._rng()
-        return [Table({name: rng.rand(self.get_num_values()) for name in names})]
+        n, arity = self.get_num_values(), self.get_arity()
+        if arity > 0:
+            return [
+                Table({name: rng.randint(0, arity, size=n).astype(np.float64) for name in names})
+            ]
+        return [Table({name: rng.rand(n) for name in names})]
 
 
 class LabeledPointWithWeightGenerator(DataGenerator):
