@@ -6,10 +6,13 @@ reference's: a JSON object of named entries, each with a `stage`
 {className, paramMap} and an `inputData` generator spec (and optional
 `modelData`). Java class names resolve to this framework's classes through
 the persistence alias map, so the reference's 36 shipped configs run
-unchanged. Results use the same schema (totalTimeMs, inputRecordNum,
-inputThroughput, outputRecordNum, outputThroughput).
+unchanged. Results use the reference's schema (totalTimeMs,
+inputRecordNum, inputThroughput, outputRecordNum, outputThroughput) plus
+one TPU-port extension: phaseTimesMs, the per-phase wall-clock breakdown
+(datagen/fit/transform/collect).
 
 CLI: python -m flink_ml_tpu.benchmark <config.json> [--output-file r.json]
+     [--profile-dir traces/]   (jax.profiler device trace for TensorBoard)
 """
 
 from __future__ import annotations
@@ -22,7 +25,7 @@ from typing import Dict, List, Optional
 
 from ..api import AlgoOperator, Estimator, Model
 from ..table import Table
-from ..utils import read_write
+from ..utils import metrics, read_write
 
 _BENCH_JAVA_PREFIX = "org.apache.flink.ml.benchmark.datagenerator."
 _BENCH_PY_MODULE = "flink_ml_tpu.benchmark.datagenerator"
@@ -60,26 +63,56 @@ def load_config(path: str) -> Dict:
 
 def run_benchmark(name: str, entry: Dict) -> Dict:
     """BenchmarkUtils.runBenchmark: generate input, fit/transform the stage,
-    time end to end, report throughput."""
-    stage = read_write.instantiate_with_params(entry["stage"])
-    input_tables = instantiate_generator(entry["inputData"]).get_data()
-    model_tables: Optional[List[Table]] = None
-    if "modelData" in entry:
-        model_tables = instantiate_generator(entry["modelData"]).get_data()
+    time end to end, report throughput — plus a per-phase wall-clock
+    breakdown (datagen/fit/transform/collect) the reference's netRuntime
+    can't show (the tool that catches host-bound ingestion regressions)."""
+    from contextlib import contextmanager
+
+    phases: Dict[str, float] = {}
+
+    @contextmanager
+    def timed_phase(phase: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            phases[phase] = phases.get(phase, 0.0) + elapsed
+            metrics.record_time(f"benchmark.{name}.{phase}", elapsed)
+
+    with timed_phase("datagen"):
+        stage = read_write.instantiate_with_params(entry["stage"])
+        input_tables = instantiate_generator(entry["inputData"]).get_data()
+        model_tables: Optional[List[Table]] = None
+        if "modelData" in entry:
+            model_tables = instantiate_generator(entry["modelData"]).get_data()
+            _block_until_ready(model_tables)
+        _block_until_ready(input_tables)
 
     num_input = sum(t.num_rows for t in input_tables)
     start = time.perf_counter()
+    # each phase blocks on its own device work so async dispatch can't leak
+    # a phase's compute into the next one's timing
     if isinstance(stage, Estimator):
-        model = stage.fit(*input_tables)
-        outputs = model.transform(*input_tables)
+        with timed_phase("fit"):
+            model = stage.fit(*input_tables)
+        with timed_phase("transform"):
+            outputs = model.transform(*input_tables)
+            _block_until_ready(outputs)
     elif isinstance(stage, Model) and model_tables is not None:
-        stage.set_model_data(*model_tables)
-        outputs = stage.transform(*input_tables)
+        with timed_phase("fit"):
+            stage.set_model_data(*model_tables)
+        with timed_phase("transform"):
+            outputs = stage.transform(*input_tables)
+            _block_until_ready(outputs)
     elif isinstance(stage, AlgoOperator):
-        outputs = stage.transform(*input_tables)
+        with timed_phase("transform"):
+            outputs = stage.transform(*input_tables)
+            _block_until_ready(outputs)
     else:
         raise TypeError(f"Unsupported stage type {type(stage).__name__}")
-    num_output = sum(t.num_rows for t in outputs)
+    with timed_phase("collect"):
+        num_output = sum(t.num_rows for t in outputs)
     elapsed_ms = (time.perf_counter() - start) * 1000.0
 
     return {
@@ -89,7 +122,20 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "inputThroughput": num_input * 1000.0 / elapsed_ms if elapsed_ms else 0.0,
         "outputRecordNum": num_output,
         "outputThroughput": num_output * 1000.0 / elapsed_ms if elapsed_ms else 0.0,
+        "phaseTimesMs": {k: v * 1000.0 for k, v in phases.items()},
     }
+
+
+def _block_until_ready(tables: List[Table]) -> None:
+    """Force device-resident columns to completion so phase timings measure
+    real work, not async dispatch."""
+    import jax
+
+    for t in tables:
+        for name in t.column_names:
+            col = t.column(name)
+            if isinstance(col, jax.Array):
+                col.block_until_ready()
 
 
 def execute_benchmarks(config: Dict) -> Dict[str, Dict]:
@@ -100,9 +146,12 @@ def execute_benchmarks(config: Dict) -> Dict[str, Dict]:
         print(f"Running benchmark {name}.")
         results[name] = run_benchmark(name, config[name])
         r = results[name]
+        phase_str = "  ".join(
+            f"{k}: {v:.1f}" for k, v in r["phaseTimesMs"].items()
+        )
         print(
             f"  totalTimeMs: {r['totalTimeMs']:.1f}  "
-            f"inputThroughput: {r['inputThroughput']:.1f} rec/s"
+            f"inputThroughput: {r['inputThroughput']:.1f} rec/s  [{phase_str}]"
         )
     print("Benchmarks execution completed.")
     return results
@@ -116,8 +165,16 @@ def main(argv: List[str]) -> None:
     output_file = None
     if "--output-file" in argv:
         output_file = argv[argv.index("--output-file") + 1]
+    profile_dir = None
+    if "--profile-dir" in argv:
+        profile_dir = argv[argv.index("--profile-dir") + 1]
     config = load_config(config_path)
-    results = execute_benchmarks(config)
+    if profile_dir:  # jax.profiler device trace, TensorBoard-loadable
+        with metrics.profile_trace(profile_dir):
+            results = execute_benchmarks(config)
+        print(f"Profiler trace written to {profile_dir}.")
+    else:
+        results = execute_benchmarks(config)
     if output_file:
         payload = {
             name: {"stage": config[name]["stage"], "results": r}

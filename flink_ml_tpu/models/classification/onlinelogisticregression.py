@@ -122,12 +122,17 @@ class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
 
     def process_updates(self, max_batches: Optional[int] = None) -> int:
         """Drain pending training batches, advancing the model version."""
+        # the reference's modelDataVersion gauge (OnlineLogisticRegressionModel.java:133)
+        from ...utils import metrics
+
+        metrics.set_gauge("OnlineLogisticRegressionModel.modelDataVersion", self.model_version)
         if self._updates is None:
             return self.model_version
         processed = 0
         for version, coeff in self._updates:
             self.coefficient = np.asarray(coeff, dtype=np.float64)
             self.model_version = version
+            metrics.set_gauge("OnlineLogisticRegressionModel.modelDataVersion", version)
             processed += 1
             if max_batches is not None and processed >= max_batches:
                 break

@@ -170,6 +170,22 @@ def _sample_without_replacement(rng: np.random.RandomState, n: int, k: int) -> n
     return np.asarray(out, dtype=np.int64)
 
 
+@partial(jax.jit, static_argnames=("n_pad", "sharding"))
+def _stage_points(X, n_pad, sharding):
+    """Device-side row padding + sharding for device-born inputs (the
+    benchmark generators produce tables in HBM) — no host round trip."""
+    if X.shape[0] != n_pad:
+        X = jnp.pad(X, [(0, n_pad - X.shape[0]), (0, 0)])
+    return jax.lax.with_sharding_constraint(X, sharding)
+
+
+@partial(jax.jit, static_argnames=("n_pad", "sharding"))
+def _unit_weights(n, n_pad, sharding):
+    # n is a traced operand: one compiled program per n_pad, not per (n, n_pad)
+    w = (jnp.arange(n_pad) < n).astype(jnp.float32)
+    return jax.lax.with_sharding_constraint(w, sharding)
+
+
 class KMeans(Estimator, KMeansParams):
     def fit(self, *inputs) -> KMeansModel:
         (table,) = inputs
@@ -178,10 +194,8 @@ class KMeans(Estimator, KMeansParams):
         if isinstance(table, StreamTable):
             return self._fit_stream(table)
         mesh = mesh_lib.default_mesh()
-        X_host = np.asarray(
-            as_dense_matrix(table.column(self.get_features_col())), dtype=np.float32
-        )
-        n, d = X_host.shape
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
+        n, d = X.shape
         k = self.get_k()
         if n < k:
             raise ValueError(f"Number of points ({n}) is less than k ({k})")
@@ -189,14 +203,21 @@ class KMeans(Estimator, KMeansParams):
         # selectRandomCentroids (KMeans.java:310): sample k rows without replacement.
         rng = np.random.RandomState(self.get_seed() % (2**32))
         centroid_idx = rng.choice(n, size=k, replace=False)
-        init_centroids = jnp.asarray(X_host[centroid_idx])
 
-        # Shard points over the data axis, weight-0 padding rows.
-        X_pad, _ = mesh_lib.pad_to_multiple(X_host, mesh_lib.num_data_shards(mesh))
-        w = np.zeros(X_pad.shape[0], dtype=np.float32)
-        w[:n] = 1.0
-        X_dev = jax.device_put(X_pad, NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None)))
-        w_dev = jax.device_put(w, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+        shards = mesh_lib.num_data_shards(mesh)
+        n_pad = -(-n // shards) * shards
+        mat_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
+        row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        if isinstance(X, jax.Array):  # device-born: stage entirely in HBM
+            X32 = X.astype(jnp.float32) if X.dtype != jnp.float32 else X
+            init_centroids = jnp.take(X32, jnp.asarray(centroid_idx), axis=0)
+            X_dev = _stage_points(X32, n_pad, mat_sharding)
+        else:
+            X_host = np.asarray(X, dtype=np.float32)
+            init_centroids = jnp.asarray(X_host[centroid_idx])
+            X_pad, _ = mesh_lib.pad_to_multiple(X_host, shards)
+            X_dev = jax.device_put(X_pad, mat_sharding)
+        w_dev = _unit_weights(n, n_pad, row_sharding)
 
         centroids, counts = _lloyd_train(
             X_dev,

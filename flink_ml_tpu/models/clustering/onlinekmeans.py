@@ -126,6 +126,10 @@ class OnlineKMeansModel(Model, KMeansModelParams):
     def process_updates(self, max_batches: Optional[int] = None) -> int:
         """Drain pending training batches, advancing the model version —
         the host-driven analogue of the unbounded feedback loop."""
+        # the reference's modelDataVersion gauge (OnlineKMeansModel.java:161-166)
+        from ...utils import metrics
+
+        metrics.set_gauge("OnlineKMeansModel.modelDataVersion", self.model_version)
         if self._updates is None:
             return self.model_version
         processed = 0
@@ -133,6 +137,7 @@ class OnlineKMeansModel(Model, KMeansModelParams):
             self.centroids = np.asarray(centroids, dtype=np.float64)
             self.weights = np.asarray(weights, dtype=np.float64)
             self.model_version = version
+            metrics.set_gauge("OnlineKMeansModel.modelDataVersion", version)
             processed += 1
             if max_batches is not None and processed >= max_batches:
                 break

@@ -115,6 +115,8 @@ def iterate_bounded(
 
 
 def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[float]):
+    from ..utils import metrics
+
     tol_value = -jnp.inf if tol is None else jnp.asarray(float(tol), jnp.float32)
 
     def cond(state):
@@ -127,9 +129,12 @@ def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[fl
         return new_carry, epoch + 1, jnp.asarray(criteria, jnp.float32)
 
     init_state = (init_carry, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
-    carry, epochs, criteria = jax.jit(
-        lambda s: lax.while_loop(cond, step, s)
-    )(init_state)
+    with metrics.timed("iteration.device_loop"):
+        carry, epochs, criteria = jax.jit(
+            lambda s: lax.while_loop(cond, step, s)
+        )(init_state)
+        jax.block_until_ready(criteria)
+    metrics.set_gauge("iteration.epochs", int(epochs))
     return IterationResult(carry, int(epochs), float(criteria))
 
 
@@ -144,10 +149,14 @@ def _iterate_host_driven(
         if restored is not None:
             carry, epoch, criteria = restored
 
+    from ..utils import metrics
+
     while epoch < max_iter and (tol is None or criteria > tol):
-        carry, criteria_arr = jitted(carry, jnp.asarray(epoch, jnp.int32))
-        criteria = float(criteria_arr)
+        with metrics.timed("iteration.epoch"):
+            carry, criteria_arr = jitted(carry, jnp.asarray(epoch, jnp.int32))
+            criteria = float(criteria_arr)
         epoch += 1
+        metrics.set_gauge("iteration.epochs", epoch)
         if listener is not None:
             listener.on_epoch_watermark_incremented(epoch, carry)
         if checkpoint_dir is not None and epoch % checkpoint_interval == 0:

@@ -87,8 +87,9 @@ class TestRunner:
         result = run_benchmark("KMeans-1", entry)
         assert set(result) == {
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
-            "outputRecordNum", "outputThroughput",
+            "outputRecordNum", "outputThroughput", "phaseTimesMs",
         }
+        assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}
         assert result["inputRecordNum"] == 200
         assert result["totalTimeMs"] > 0
 
