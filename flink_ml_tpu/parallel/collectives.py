@@ -85,13 +85,18 @@ def shard_map_over(mesh: Mesh, in_specs, out_specs, fn=None, check_vma: bool = F
     return wrap(fn) if fn is not None else wrap
 
 
-def host_all_reduce_sum(mesh: Mesh, x):
-    """All-reduce a host-visible array over the data axis of `mesh` by a
-    one-off jitted psum — used by host-driven (unbounded) loops."""
+def host_all_reduce_sum(mesh: Mesh, xs):
+    """Sum per-shard host arrays into one replicated device array.
+
+    Host-driven (unbounded) loops accumulate per-data-shard partials on host
+    (the analogue of the reference's per-subtask accumulators funneled through
+    countWindowAll, OnlineKMeans.java pattern); this reduces them with one
+    device-side tree-sum and publishes the result replicated over `mesh`.
+    """
     sharding = NamedSharding(mesh, P())
 
     @partial(jax.jit, out_shardings=sharding)
-    def _sum(v):
-        return jnp.asarray(v)
+    def _sum(stacked):
+        return jnp.sum(stacked, axis=0)
 
-    return _sum(x)
+    return _sum(jnp.stack([jnp.asarray(x) for x in xs]))

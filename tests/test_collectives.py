@@ -99,6 +99,21 @@ def test_feature_sharded_sgd_matches_replicated(mesh_2d):
     np.testing.assert_allclose(c1, c2, rtol=1e-4, atol=1e-5)
 
 
+def test_host_all_reduce_sum(mesh8):
+    """Host-side partials reduce to one replicated sum on device."""
+    partials = [np.full(4, float(i), np.float32) for i in range(8)]
+    out = coll.host_all_reduce_sum(mesh8, partials)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 28.0))
+    assert out.sharding.is_fully_replicated
+
+
+def test_init_distributed_noop():
+    """Single-process bring-up: no coordinator address means no-op (the
+    DCN hook must be safe to call unconditionally at startup)."""
+    mesh_lib.init_distributed()  # must not raise or touch jax.distributed
+    mesh_lib.init_distributed(coordinator_address=None)
+
+
 def test_feature_sharded_with_regularization(mesh_2d):
     import numpy as np
     from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
