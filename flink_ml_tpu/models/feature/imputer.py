@@ -3,8 +3,10 @@
 TPU-native re-design of feature/imputer/Imputer.java (per-column surrogate
 computed while ignoring `missingValue` and NaN entries; MeanStrategy /
 MedianStrategy / MostFrequentStrategy aggregators) and ImputerModel.java.
-Median is an exact device quantile instead of a Greenwald-Khanna sketch
-(`relativeError` accepted for API parity).
+Bounded-Table median is an exact quantile; a `StreamTable` fits
+out-of-core — median via per-column Greenwald-Khanna sketches honoring
+`relativeError` (the reference's QuantileSummary path), mean via running
+(sum, count), most_frequent via streaming value counts.
 """
 
 from __future__ import annotations
@@ -105,6 +107,10 @@ class ImputerModel(Model, ImputerModelParams):
 class Imputer(Estimator, ImputerParams):
     def fit(self, *inputs: Table) -> ImputerModel:
         (table,) = inputs
+        from ...table import StreamTable
+
+        if isinstance(table, StreamTable):
+            return self._fit_stream(table)
         missing = self.get_missing_value()
         strategy = self.get_strategy()
         surrogates: Dict[str, float] = {}
@@ -121,6 +127,57 @@ class Imputer(Estimator, ImputerParams):
             else:  # most_frequent: smallest among the most frequent values
                 values, counts = np.unique(valid, return_counts=True)
                 surrogates[name] = float(values[np.argmax(counts)])
+        model = ImputerModel()
+        model.surrogates = surrogates
+        update_existing_params(model, self)
+        return model
+
+    def _fit_stream(self, stream) -> ImputerModel:
+        """Out-of-core fit over a StreamTable: mean keeps (sum, count),
+        median keeps a Greenwald-Khanna sketch per column honoring
+        `relativeError` (the reference's QuantileSummary path), most_frequent
+        keeps value counts — all updated one mini-batch at a time."""
+        from ...common.quantilesummary import QuantileSummary
+
+        missing = self.get_missing_value()
+        strategy = self.get_strategy()
+        cols = self.get_input_cols()
+        sums = {name: 0.0 for name in cols}
+        counts = {name: 0 for name in cols}
+        sketches = {name: QuantileSummary(self.get_relative_error()) for name in cols}
+        freqs: Dict[str, Dict[float, int]] = {name: {} for name in cols}
+        for batch in stream:
+            for name in cols:
+                arr = np.asarray(batch.column(name), dtype=np.float64)
+                mask = np.isnan(arr) if np.isnan(missing) else (arr == missing) | np.isnan(arr)
+                valid = arr[~mask]
+                if valid.size == 0:
+                    continue
+                if strategy == MEAN:
+                    sums[name] += float(valid.sum())
+                    counts[name] += int(valid.size)
+                elif strategy == MEDIAN:
+                    sketches[name].insert_batch(valid)
+                else:
+                    values, vcounts = np.unique(valid, return_counts=True)
+                    table_counts = freqs[name]
+                    for v, c in zip(values, vcounts):
+                        table_counts[float(v)] = table_counts.get(float(v), 0) + int(c)
+        surrogates: Dict[str, float] = {}
+        for name in cols:
+            if strategy == MEAN:
+                if counts[name] == 0:
+                    raise ValueError(f"Column {name} has no valid values to impute from")
+                surrogates[name] = sums[name] / counts[name]
+            elif strategy == MEDIAN:
+                if sketches[name].is_empty():
+                    raise ValueError(f"Column {name} has no valid values to impute from")
+                surrogates[name] = float(sketches[name].compress().query(0.5))
+            else:
+                if not freqs[name]:
+                    raise ValueError(f"Column {name} has no valid values to impute from")
+                best = max(freqs[name].items(), key=lambda kv: (kv[1], -kv[0]))
+                surrogates[name] = best[0]
         model = ImputerModel()
         model.surrogates = surrogates
         update_existing_params(model, self)

@@ -5,7 +5,9 @@ TPU-native re-design of feature/kbinsdiscretizer/KBinsDiscretizer.java:341
 (strategies UNIFORM / QUANTILE / KMEANS; `subSamples` caps the fit sample;
 model = per-feature bin edges; duplicate quantile edges collapse) and
 KBinsDiscretizerModel.java (searchsorted bucketing, values outside range
-clamp to the first/last bin). Quantiles/kmeans run as batched device ops.
+clamp to the first/last bin). Quantiles/kmeans run as batched device ops;
+a `StreamTable` input fits out-of-core (GK sketches / streaming min-max /
+reservoir subsampling per strategy).
 """
 
 from __future__ import annotations
@@ -123,6 +125,10 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
 class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
     def fit(self, *inputs: Table) -> KBinsDiscretizerModel:
         (table,) = inputs
+        from ...table import StreamTable
+
+        if isinstance(table, StreamTable):
+            return self._fit_stream(table)
         X = as_dense_matrix(table.column(self.get_input_col()))
         sub = self.get_sub_samples()
         if X.shape[0] > sub:
@@ -145,6 +151,54 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
             else:
                 edges = _kmeans_1d_edges(col, num_bins)
             edges_list.append(np.asarray(edges, dtype=np.float64))
+        model = KBinsDiscretizerModel()
+        model.bin_edges = edges_list
+        update_existing_params(model, self)
+        return model
+
+    def _fit_stream(self, stream) -> KBinsDiscretizerModel:
+        """Out-of-core fit over a StreamTable. QUANTILE uses per-feature
+        Greenwald-Khanna sketches over the full stream (the reference's
+        QuantileSummary path); UNIFORM keeps streaming min/max; KMEANS
+        reservoir-samples `subSamples` rows (DataStreamUtils.sample
+        semantics) and runs the in-memory 1-D Lloyd on the sample."""
+        from ...common.quantilesummary import column_sketches, update_column_sketches
+        from ...utils.datastream import sample as reservoir_sample
+
+        strategy = self.get_strategy()
+        num_bins = self.get_num_bins()
+        col_name = self.get_input_col()
+        if strategy == KMEANS:
+            sampled = reservoir_sample(stream, self.get_sub_samples(), seed=0)
+            return self.fit(sampled)
+        sketches = None
+        mins = maxs = None
+        for batch in stream:
+            X = as_dense_matrix(batch.column(col_name))
+            if X.shape[0] == 0:
+                continue
+            if strategy == QUANTILE:
+                if sketches is None:
+                    # GK relative error 1e-4: bin-boundary rank error well
+                    # under one bin for the reference's default numBins
+                    sketches = column_sketches(X.shape[1], 1e-4)
+                update_column_sketches(sketches, X)
+            else:
+                bmin, bmax = X.min(axis=0), X.max(axis=0)
+                mins = bmin if mins is None else np.minimum(mins, bmin)
+                maxs = bmax if maxs is None else np.maximum(maxs, bmax)
+        edges_list: List[np.ndarray] = []
+        if strategy == QUANTILE:
+            if sketches is None:
+                raise ValueError("cannot fit KBinsDiscretizer on an empty stream")
+            qs = np.linspace(0.0, 1.0, num_bins + 1)
+            for s in sketches:
+                edges_list.append(np.unique(np.asarray(s.compress().query(qs), dtype=np.float64)))
+        else:
+            if mins is None:
+                raise ValueError("cannot fit KBinsDiscretizer on an empty stream")
+            for j in range(mins.size):
+                edges_list.append(np.unique(np.linspace(mins[j], maxs[j], num_bins + 1)))
         model = KBinsDiscretizerModel()
         model.bin_edges = edges_list
         update_existing_params(model, self)

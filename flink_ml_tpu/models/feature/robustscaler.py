@@ -4,9 +4,10 @@ TPU-native re-design of feature/robustscaler/RobustScaler.java +
 RobustScalerModelParams.java (withCentering default false, withScaling
 default true; model = per-feature medians and [lower, upper] quantile
 ranges). The reference approximates quantiles with Greenwald-Khanna
-summaries (common/util/QuantileSummary.java, driven by `relativeError`);
-on TPU an exact device sort is faster than maintaining a sketch, so
-quantiles are exact (relativeError is accepted for API parity).
+summaries (common/util/QuantileSummary.java, driven by `relativeError`).
+Here a bounded Table uses an exact device sort (faster than a sketch when
+the data fits); a `StreamTable` fits out-of-core through per-feature GK
+sketches (common/quantilesummary.py) honoring `relativeError`.
 """
 
 from __future__ import annotations
@@ -124,11 +125,35 @@ def _quantiles(X, qs):
 class RobustScaler(Estimator, RobustScalerParams):
     def fit(self, *inputs: Table) -> RobustScalerModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
-        qs = jnp.asarray([0.5, self.get_lower(), self.get_upper()])
-        med, lo, hi = np.asarray(_quantiles(jnp.asarray(X), qs), dtype=np.float64)
+        from ...table import StreamTable
+
+        if isinstance(table, StreamTable):
+            med, lo, hi = self._fit_stream(table)
+        else:
+            X = as_dense_matrix(table.column(self.get_input_col()))
+            qs = jnp.asarray([0.5, self.get_lower(), self.get_upper()])
+            med, lo, hi = np.asarray(_quantiles(jnp.asarray(X), qs), dtype=np.float64)
         model = RobustScalerModel()
         model.medians = med
         model.ranges = hi - lo
         update_existing_params(model, self)
         return model
+
+    def _fit_stream(self, stream):
+        """Out-of-core fit: per-feature Greenwald-Khanna sketches updated
+        batch by batch, honoring `relativeError` — the reference's
+        distributed path (RobustScaler.java via common/util/QuantileSummary.java)."""
+        from ...common.quantilesummary import column_sketches, update_column_sketches
+
+        sketches = None
+        col_name = self.get_input_col()
+        for batch in stream:
+            X = as_dense_matrix(batch.column(col_name))
+            if sketches is None:
+                sketches = column_sketches(X.shape[1], self.get_relative_error())
+            update_column_sketches(sketches, X)
+        if sketches is None:
+            raise ValueError("cannot fit RobustScaler on an empty stream")
+        qs = np.asarray([0.5, self.get_lower(), self.get_upper()])
+        out = np.stack([s.compress().query(qs) for s in sketches], axis=1)
+        return out[0], out[1], out[2]

@@ -1,0 +1,100 @@
+"""Bounded-stream utility ops over Tables / StreamTables.
+
+TPU-native analogues of the reference's DataStreamUtils batch helpers
+(`common/datastream/DataStreamUtils.java`): `aggregate` (:182) — a generic
+accumulator fold over a bounded stream with a final merge, and `sample`
+(:212) — uniform reservoir sampling of k rows. The reference implements
+these as custom BoundedOneInput operators with ListState; here a
+StreamTable is already an iterator of bounded mini-batch Tables, so the
+same contracts become host-side folds over batches with vectorized
+per-batch work (the accumulator math stays numpy/jax-friendly).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, TypeVar, Union
+
+import numpy as np
+
+from ..table import StreamTable, Table
+
+A = TypeVar("A")
+R = TypeVar("R")
+
+__all__ = ["aggregate", "sample", "iter_batches"]
+
+
+def iter_batches(data: Union[Table, StreamTable]) -> Iterable[Table]:
+    """Uniform batch view: a bounded Table is a one-batch stream."""
+    if isinstance(data, Table):
+        return [data]
+    return data
+
+
+def aggregate(
+    data: Union[Table, StreamTable],
+    create_accumulator: Callable[[], A],
+    add: Callable[[A, Table], A],
+    get_result: Callable[[A], R],
+    merge: Optional[Callable[[A, A], A]] = None,
+) -> R:
+    """Generic bounded aggregation (DataStreamUtils.aggregate, :182): fold
+    every batch into an accumulator, then extract the result. `add` receives
+    a whole mini-batch Table (vectorize inside it); `merge` is accepted for
+    API parity with partition-parallel callers that combine per-shard
+    accumulators themselves."""
+    acc = create_accumulator()
+    for batch in iter_batches(data):
+        acc = add(acc, batch)
+    return get_result(acc)
+
+
+def sample(
+    data: Union[Table, StreamTable], num_samples: int, seed: int = 0
+) -> Table:
+    """Uniform reservoir sample of `num_samples` rows without replacement
+    (DataStreamUtils.sample, :212 — Algorithm R, batch-vectorized: each
+    incoming batch draws its candidate positions in one RNG call instead of
+    a per-row coin flip)."""
+    if num_samples <= 0:
+        raise ValueError("num_samples must be > 0")
+    rng = np.random.RandomState(seed)
+    reservoir: Optional[Table] = None
+    seen = 0
+    for batch in iter_batches(data):
+        n = batch.num_rows
+        if n == 0:
+            continue
+        if reservoir is None or reservoir.num_rows < num_samples:
+            have = 0 if reservoir is None else reservoir.num_rows
+            take = min(num_samples - have, n)
+            head = batch.take(np.arange(take))
+            reservoir = head if reservoir is None else reservoir.concat(head)
+            seen += take
+            if take == n:
+                continue
+            batch = batch.take(np.arange(take, n))
+            n = batch.num_rows
+        # each remaining row i (global index seen+i) replaces a reservoir
+        # slot with probability k/(seen+i+1), landing in a uniform slot
+        global_idx = seen + np.arange(n) + 1
+        accept = rng.random(n) < num_samples / global_idx
+        slots = rng.randint(0, num_samples, size=n)
+        seen += n
+        if not np.any(accept):
+            continue
+        # later rows overwrite earlier ones in the same slot (stream order)
+        replace_rows = np.nonzero(accept)[0]
+        keep = np.arange(reservoir.num_rows)
+        incoming: List[int] = [-1] * num_samples
+        for i in replace_rows:
+            incoming[slots[i]] = int(i)
+        repl_slots = [s for s, i in enumerate(incoming) if i >= 0]
+        repl_idx = [incoming[s] for s in repl_slots]
+        survivors = np.setdiff1d(keep, np.asarray(repl_slots, dtype=np.int64))
+        new_rows = batch.take(np.asarray(repl_idx, dtype=np.int64))
+        reservoir_kept = reservoir.take(survivors)
+        reservoir = reservoir_kept.concat(new_rows)
+    if reservoir is None:
+        raise ValueError("cannot sample from an empty stream")
+    return reservoir

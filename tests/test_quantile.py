@@ -1,0 +1,315 @@
+"""Greenwald-Khanna sketch + out-of-core quantile stages + stream utils.
+
+Mirrors the reference's QuantileSummary usage (common/util/
+QuantileSummary.java driving RobustScaler / KBinsDiscretizer / Imputer)
+and DataStreamUtils.aggregate/sample (:182/:212): sketch rank-error within
+epsilon, merge correctness, stream-vs-in-memory stage parity, and a
+forced-spill fit through the native data cache.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.quantilesummary import (
+    QuantileSummary,
+    column_sketches,
+    update_column_sketches,
+)
+from flink_ml_tpu.table import StreamTable, Table
+from flink_ml_tpu.utils.datastream import aggregate, sample
+
+
+def rank_error(data_sorted, value, p):
+    rank = np.searchsorted(data_sorted, value, side="left")
+    return abs(rank - p * len(data_sorted)) / len(data_sorted)
+
+
+PS = np.array([0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99])
+
+
+class TestQuantileSummary:
+    def test_rank_error_within_epsilon(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=200_000)
+        eps = 0.001
+        s = QuantileSummary(eps)
+        for chunk in np.array_split(data, 23):
+            s.insert_batch(chunk)
+        s.compress()
+        sorted_d = np.sort(data)
+        for p, v in zip(PS, s.query(PS)):
+            assert rank_error(sorted_d, v, p) <= 2 * eps
+
+    def test_single_inserts_match_batch(self):
+        rng = np.random.default_rng(1)
+        data = rng.random(500)
+        a = QuantileSummary(0.01)
+        b = QuantileSummary(0.01)
+        for x in data:
+            a.insert(float(x))
+        b.insert_batch(data)
+        assert a.compress().query(0.5) == b.compress().query(0.5)
+
+    def test_merge_partitions(self):
+        rng = np.random.default_rng(2)
+        data = rng.exponential(size=120_000)
+        eps = 0.005
+        sketches = []
+        for part in np.array_split(data, 7):  # uneven partitions
+            t = QuantileSummary(eps)
+            t.insert_batch(part)
+            sketches.append(t.compress())
+        merged = sketches[0]
+        for t in sketches[1:]:
+            merged = merged.merge(t)
+        assert merged.count == len(data)
+        sorted_d = np.sort(data)
+        for p, v in zip(PS, merged.query(PS)):
+            assert rank_error(sorted_d, v, p) <= 4 * eps
+
+    def test_merge_empty(self):
+        a = QuantileSummary(0.01)
+        b = QuantileSummary(0.01)
+        b.insert_batch(np.arange(100.0))
+        b.compress()
+        assert a.merge(b).query(0.5) == b.query(0.5)
+        assert b.merge(a).query(0.5) == b.query(0.5)
+
+    def test_endpoint_shortcircuit(self):
+        s = QuantileSummary(0.05)
+        s.insert_batch(np.arange(1000.0))
+        s.compress()
+        assert s.query(0.0) == 0.0  # p <= eps -> min
+        assert s.query(1.0) == 999.0  # p >= 1-eps -> max
+
+    def test_query_requires_compress_and_data(self):
+        s = QuantileSummary(0.01)
+        with pytest.raises(ValueError):
+            s.query(0.5)
+        s.insert_batch(np.arange(10.0))
+        with pytest.raises(ValueError):
+            s.query(0.5)  # uncompressed head buffer
+        s.compress()
+        with pytest.raises(ValueError):
+            s.query(1.5)
+
+    def test_merge_requires_compressed(self):
+        a = QuantileSummary(0.01)
+        a.insert_batch(np.arange(10.0))
+        b = QuantileSummary(0.01)
+        b.insert_batch(np.arange(10.0))
+        b.compress()
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+    def test_space_stays_sublinear(self):
+        s = QuantileSummary(0.01)
+        rng = np.random.default_rng(3)
+        for _ in range(20):
+            s.insert_batch(rng.random(60_000))
+        s.compress()
+        assert s.count == 1_200_000
+        assert s._values.size < 5_000  # GK bound ~ O((1/eps) log(eps n))
+
+    def test_column_sketches_with_mask(self):
+        X = np.array([[1.0, 10.0], [2.0, np.nan], [3.0, 30.0], [4.0, 40.0]])
+        sketches = column_sketches(2, 0.01)
+        update_column_sketches(sketches, X, mask=~np.isnan(X))
+        assert sketches[0].compress().count == 4
+        assert sketches[1].compress().count == 3
+
+
+def _stream(X, n_batches, extra_cols=None, budget=None):
+    """Split X row-wise into a StreamTable, optionally via the native
+    spillable cache with a tiny memory budget (forces spill)."""
+    batches = []
+    for part in np.array_split(np.arange(len(X)), n_batches):
+        cols = {"features": X[part]}
+        for name, col in (extra_cols or {}).items():
+            cols[name] = col[part]
+        batches.append(Table(cols))
+    if budget is not None:
+        from flink_ml_tpu.native.datacache import ReplayableStreamTable
+
+        return StreamTable(ReplayableStreamTable(batches, memory_budget_bytes=budget))
+    return StreamTable.from_batches(batches)
+
+
+class TestStreamQuantileStages:
+    def test_robustscaler_stream_parity(self):
+        from flink_ml_tpu.models.feature.robustscaler import RobustScaler
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(120_000, 3)) * np.array([1.0, 5.0, 0.1])
+        scaler = RobustScaler().set_input_col("features").set_output_col("out")
+        exact = scaler.fit(Table({"features": X}))
+        streamed = scaler.fit(_stream(X, 11))
+        # medians/ranges agree to the sketch's rank error translated to value
+        # space: on 120k gaussian rows eps=1e-3 rank error ~ tiny value shift
+        assert np.all(np.abs(streamed.medians - exact.medians) <= 0.02 * np.abs(exact.ranges))
+        np.testing.assert_allclose(streamed.ranges, exact.ranges, rtol=0.05)
+
+    def test_robustscaler_forced_spill(self):
+        from flink_ml_tpu.models.feature.robustscaler import RobustScaler
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(50_000, 4))
+        stream = _stream(X, 10, budget=64 << 10)  # 64KB budget: must spill
+        inner = stream._batches
+        scaler = RobustScaler().set_input_col("features").set_output_col("out")
+        model = scaler.fit(stream)
+        assert inner.stats["spilledSegments"] > 0
+        exact = scaler.fit(Table({"features": X}))
+        np.testing.assert_allclose(model.medians, exact.medians, atol=0.05)
+
+    def test_imputer_stream_median_parity(self):
+        from flink_ml_tpu.models.feature.imputer import Imputer
+
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=100_000)
+        a[rng.random(a.size) < 0.1] = np.nan
+        imputer = (
+            Imputer()
+            .set_input_cols("a")
+            .set_output_cols("a_out")
+            .set_strategy("median")
+        )
+        batches = [
+            Table({"a": part}) for part in np.array_split(a, 9)
+        ]
+        streamed = imputer.fit(StreamTable.from_batches(batches))
+        exact = imputer.fit(Table({"a": a}))
+        assert abs(streamed.surrogates["a"] - exact.surrogates["a"]) < 0.02
+
+    def test_imputer_stream_mean_and_most_frequent_exact(self):
+        from flink_ml_tpu.models.feature.imputer import Imputer
+
+        a = np.array([1.0, 2.0, 2.0, 3.0, np.nan, 2.0, 9.0, 1.0])
+        for strategy, expected in [("mean", np.nanmean(a)), ("most_frequent", 2.0)]:
+            imputer = (
+                Imputer()
+                .set_input_cols("a")
+                .set_output_cols("a_out")
+                .set_strategy(strategy)
+            )
+            batches = [Table({"a": a[:3]}), Table({"a": a[3:]})]
+            streamed = imputer.fit(StreamTable.from_batches(batches))
+            assert streamed.surrogates["a"] == pytest.approx(expected)
+
+    def test_kbins_stream_quantile_parity(self):
+        from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(80_000, 2))
+        est = (
+            KBinsDiscretizer()
+            .set_input_col("features")
+            .set_output_col("out")
+            .set_strategy("quantile")
+            .set_num_bins(4)
+            .set_sub_samples(1_000_000)
+        )
+        exact = est.fit(Table({"features": X}))
+        streamed = est.fit(_stream(X, 8))
+        for e_exact, e_stream in zip(exact.bin_edges, streamed.bin_edges):
+            assert e_exact.size == e_stream.size
+            np.testing.assert_allclose(e_stream[1:-1], e_exact[1:-1], atol=0.02)
+
+    def test_kbins_stream_uniform_exact(self):
+        from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer
+
+        rng = np.random.default_rng(4)
+        X = rng.random((10_000, 2))
+        est = (
+            KBinsDiscretizer()
+            .set_input_col("features")
+            .set_output_col("out")
+            .set_strategy("uniform")
+            .set_num_bins(5)
+        )
+        exact = est.fit(Table({"features": X}))
+        streamed = est.fit(_stream(X, 7))
+        for e_exact, e_stream in zip(exact.bin_edges, streamed.bin_edges):
+            np.testing.assert_allclose(e_stream, e_exact)
+
+    def test_kbins_stream_empty_batch_skipped(self):
+        from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer
+
+        rng = np.random.default_rng(6)
+        batches = [
+            Table({"features": rng.random((10, 3))}),
+            Table({"features": np.empty((0, 3))}),
+        ]
+        for strategy in ("uniform", "quantile"):
+            est = (
+                KBinsDiscretizer()
+                .set_input_col("features")
+                .set_output_col("out")
+                .set_strategy(strategy)
+            )
+            model = est.fit(StreamTable.from_batches(batches))
+            assert len(model.bin_edges) == 3
+
+    def test_kbins_stream_kmeans_reservoir(self):
+        from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer
+
+        rng = np.random.default_rng(5)
+        # two well-separated blobs: sampled kmeans must find the gap
+        X = np.concatenate([rng.normal(0, 0.1, 5_000), rng.normal(10, 0.1, 5_000)])[:, None]
+        est = (
+            KBinsDiscretizer()
+            .set_input_col("features")
+            .set_output_col("out")
+            .set_strategy("kmeans")
+            .set_num_bins(2)
+            .set_sub_samples(2_000)
+        )
+        streamed = est.fit(_stream(X, 5))
+        edges = streamed.bin_edges[0]
+        assert edges.size == 3
+        assert 3.0 < edges[1] < 7.0
+
+
+class TestDataStreamUtils:
+    def test_aggregate_sum(self):
+        batches = [Table({"x": np.arange(10.0)}), Table({"x": np.arange(10.0, 25.0)})]
+        total = aggregate(
+            StreamTable.from_batches(batches),
+            create_accumulator=lambda: 0.0,
+            add=lambda acc, t: acc + float(np.sum(t.column("x"))),
+            get_result=lambda acc: acc,
+        )
+        assert total == pytest.approx(np.arange(25.0).sum())
+
+    def test_aggregate_bounded_table(self):
+        total = aggregate(
+            Table({"x": np.arange(5.0)}),
+            create_accumulator=lambda: 0.0,
+            add=lambda acc, t: acc + float(np.sum(t.column("x"))),
+            get_result=lambda acc: acc,
+        )
+        assert total == 10.0
+
+    def test_sample_size_and_membership(self):
+        rng = np.random.default_rng(0)
+        X = rng.random((5_000, 2))
+        batches = [Table({"x": part}) for part in np.array_split(X, 13)]
+        out = sample(StreamTable.from_batches(batches), 100, seed=7)
+        assert out.num_rows == 100
+        flat = {tuple(r) for r in np.asarray(X)}
+        for row in np.asarray(out.column("x")):
+            assert tuple(row) in flat
+
+    def test_sample_fewer_rows_than_k(self):
+        out = sample(Table({"x": np.arange(5.0)}), 100)
+        assert out.num_rows == 5
+
+    def test_sample_roughly_uniform(self):
+        # each of 200 rows should land in a k=50 sample ~25% of the time
+        hits = np.zeros(200)
+        for seed in range(120):
+            out = sample(Table({"x": np.arange(200.0)}), 50, seed=seed)
+            hits[np.asarray(out.column("x"), dtype=int)] += 1
+        freq = hits / 120
+        assert 0.15 < freq.mean() < 0.35
+        assert freq.min() > 0.05  # no starved rows
