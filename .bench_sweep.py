@@ -1,0 +1,38 @@
+"""Timing sweep: run every conf/ config once at reference size, log per-stage times."""
+import glob
+import json
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+from flink_ml_tpu.benchmark import runner
+
+results = {}
+paths = sorted(glob.glob("conf/*.json"))
+for path in paths:
+    config = runner.load_config(path)
+    for name, entry in config.items():
+        if name == "version":
+            continue
+        t0 = time.perf_counter()
+        try:
+            r = runner.run_benchmark(name, entry)
+            wall = time.perf_counter() - t0
+            results[path] = {"name": name, "wallS": wall, "result": r}
+            print(f"{os.path.basename(path):45s} {wall:8.1f}s  total {r['totalTimeMs']:9.1f}ms  thr {r['inputThroughput']:12.1f} rec/s", flush=True)
+        except Exception as e:
+            wall = time.perf_counter() - t0
+            results[path] = {"name": name, "wallS": wall, "error": repr(e)}
+            print(f"{os.path.basename(path):45s} {wall:8.1f}s  ERROR {e!r}", flush=True)
+            traceback.print_exc()
+
+with open(".bench_sweep_results.json", "w") as f:
+    json.dump(results, f, indent=2)
+print("done", flush=True)
