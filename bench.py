@@ -94,8 +94,12 @@ def _gen_table(num_rows, seed):
 
 def bench_logreg(num_rows, in_budget=lambda: True):
     """North-star workload. Reports cold (includes XLA compile) and warm
-    end-to-end job times (datagen + fit, the reference's netRuntime span),
-    plus a fit-only split and an MFU estimate."""
+    end-to-end job times (datagen + fit, the reference's netRuntime span).
+    Because gen and fit are NOT separated by a device sync (see loop note),
+    fitTimeMs absorbs the pipelined device-side datagen: it is the span
+    from fit() call to model-on-host, and trainLoopMFU computed from it is
+    a lower bound on the true train-loop MFU. totalTimeMs (gen dispatch +
+    fit) is the honest job span and the basis of every throughput number."""
     import jax
 
     runs = []
@@ -103,9 +107,13 @@ def bench_logreg(num_rows, in_budget=lambda: True):
     for i in range(3):  # run 0 = cold (compile), then steady state
         if i > 0 and len(runs) > 1 and not in_budget():
             break
+        # No sync between gen and fit: generation, batching, and training
+        # pipeline as async dispatches, and fit's single packed readback is
+        # the only host round trip. t_gen+t_fit still spans datagen through
+        # model-on-host (the reference's netRuntime span) — fit just absorbs
+        # the device-side generation time.
         t0 = time.perf_counter()
         table = _gen_table(num_rows, seed=2 + i)
-        jax.block_until_ready(table.column("features"))
         t_gen = time.perf_counter() - t0
         t0 = time.perf_counter()
         model = _make_logreg(num_rows).fit(table)

@@ -15,10 +15,12 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.losses import LossFunc
-from ..ops.optimizer import SGD
+from ..ops.optimizer import SGD, read_train_result
 from ..table import Table, as_dense_matrix
 
 
@@ -87,13 +89,37 @@ def run_sgd(
         )
         coeff, loss, epochs, _ = optimizer.optimize_stream(None, chunks, loss_func)
         return coeff, loss, epochs
-    if validate_binomial:
-        validate_binomial_labels(table.column(params.get_label_col()))
     X, y, w = extract_train_data(
         table, params.get_features_col(), params.get_label_col(), weight_col
     )
+    flag = None
+    if validate_binomial:
+        if isinstance(y, jax.Array):
+            # device labels: compute the validity flag on device and read it
+            # back fused with the training result — a standalone bool() here
+            # would cost its own host round trip before training even starts
+            flag = _labels_ok(y)
+        else:
+            validate_binomial_labels(y)
     init_coeff = np.zeros(X.shape[1], dtype=np.float64)
-    return optimizer.optimize(init_coeff, X, y, w, loss_func)
+    result = optimizer.optimize_async(init_coeff, X, y, w, loss_func)
+    flag_val, coeff, criteria, epochs = read_train_result(result, flag=flag)
+    _raise_if_invalid(flag_val)
+    return coeff, criteria, epochs
+
+
+@jax.jit
+def _labels_ok(y):
+    """Device-side {0,1} label check (LogisticRegression.java:78-87)."""
+    return jnp.all((y == 0.0) | (y == 1.0)).astype(jnp.float32)
+
+
+def _raise_if_invalid(flag) -> None:
+    if flag is not None and not bool(flag):
+        raise ValueError(
+            "Multinomial classification is not supported yet. "
+            "Supported options: [auto, binomial]."
+        )
 
 
 def _stream_chunks(stream, features_col, label_col, weight_col, validate_binomial):
@@ -110,15 +136,8 @@ def validate_binomial_labels(y) -> None:
     """The reference only supports {0, 1} labels for binary linear
     classifiers (LogisticRegression.java:78-87). Device-resident labels are
     validated on device (one scalar readback, no bulk transfer)."""
-    import jax
-    import jax.numpy as jnp
-
     if isinstance(y, jax.Array):
-        ok = bool(jnp.all((y == 0.0) | (y == 1.0)))
+        ok = bool(_labels_ok(y))
     else:
         ok = bool(np.all((y == 0.0) | (y == 1.0)))
-    if not ok:
-        raise ValueError(
-            "Multinomial classification is not supported yet. "
-            "Supported options: [auto, binomial]."
-        )
+    _raise_if_invalid(ok)

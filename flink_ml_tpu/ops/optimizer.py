@@ -121,6 +121,55 @@ def _update_model(coeff, grad, wsum, lr, reg, elastic_net):
     return lax.cond(wsum > 0, do_update, lambda c: c, coeff)
 
 
+@partial(jax.jit, static_argnames=("loss_func", "batch", "has_weights"))
+def _sgd_train_flat(
+    X, y, w, init_coeff, loss_func, batch, has_weights, n, max_iter, tol, lr, reg, elastic_net
+):
+    """Single-data-shard variant of `_sgd_train` that slices each epoch's
+    batch straight out of the FLAT row-major arrays with a dynamic slice.
+
+    The batched (num_batches, B, d) layout exists so every batch spans all
+    data shards; with one data shard it is a pure 4GB copy program on the
+    critical path (measured ~130ms of the benchmark fit on the remote
+    tunnel). Here the only programs in the fit chain are this train loop
+    and the result pack. Rows are pre-padded to a batch multiple; absent
+    weights are synthesized in-loop as (row_index < n) so padding rows
+    contribute nothing and no separate weights program runs."""
+    num_batches = X.shape[0] // batch
+    d = X.shape[-1]
+    dtype = X.dtype
+
+    def cond(state):
+        _, _, _, epoch, criteria = state
+        return jnp.logical_and(epoch < max_iter, criteria > tol)
+
+    def body(state):
+        coeff, grad, wsum, epoch, _ = state
+        k = jnp.mod(epoch, num_batches)
+        start = k * batch
+        Xk = lax.dynamic_slice_in_dim(X, start, batch, 0)
+        yk = lax.dynamic_slice_in_dim(y, start, batch, 0)
+        if has_weights:
+            wk = lax.dynamic_slice_in_dim(w, start, batch, 0)
+        else:
+            wk = ((jnp.arange(batch) + start) < n).astype(dtype)
+        carry, criteria = _epoch_step(
+            Xk, yk, wk, (coeff, grad, wsum, epoch), loss_func, lr, reg, elastic_net
+        )
+        return carry + (criteria,)
+
+    init_state = (
+        jnp.asarray(init_coeff, dtype),
+        jnp.zeros((d,), dtype),
+        jnp.asarray(0.0, dtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(jnp.inf, jnp.float32),
+    )
+    coeff, grad, wsum, epochs, criteria = lax.while_loop(cond, body, init_state)
+    coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    return coeff, criteria, epochs
+
+
 @partial(jax.jit, static_argnames=("loss_func",))
 def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, elastic_net):
     """The full bounded training iteration as one XLA program.
@@ -182,6 +231,46 @@ def _stream_epoch(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
     return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
 
 
+@jax.jit
+def _pack_result(coeff, criteria, epochs, flag=None):
+    """Fuse (coeff, criteria, epochs[, leading flag]) into ONE flat array so
+    the host reads everything back in a single transfer. On remote-attached
+    TPUs each output array's first readback is a full host round trip
+    (~100ms over the tunnel), so a 3-output result costs 3x the latency of
+    a packed one — this was the dominant cost of the whole benchmark fit.
+    Packs in at least float32 so integer epoch counts stay exact under
+    low-precision compute dtypes (bfloat16 is exact only to 256)."""
+    dt = jnp.promote_types(coeff.dtype, jnp.float32)
+    parts = [
+        coeff.astype(dt),
+        jnp.reshape(jnp.asarray(criteria).astype(dt), (1,)),
+        jnp.reshape(jnp.asarray(epochs).astype(dt), (1,)),
+    ]
+    if flag is not None:
+        parts.insert(0, jnp.reshape(flag.astype(dt), (1,)))
+    return jnp.concatenate(parts)
+
+
+def unpack_train_result(host: np.ndarray, d: int, has_flag: bool = False):
+    """Host-side inverse of `_pack_result`: returns
+    (flag_or_None, coeff[:d], criteria, epochs)."""
+    flag = float(host[0]) if has_flag else None
+    off = 1 if has_flag else 0
+    return flag, host[off : off + d], float(host[-2]), int(host[-1])
+
+
+def read_train_result(async_result, flag=None):
+    """Materialize an `optimize_async` result on the host in one transfer,
+    optionally fusing an extra device scalar (e.g. a label-validity flag)
+    into the same readback. Returns (flag_or_None, coeff[:d], criteria,
+    epochs); the checkpointed host-driven path passes through unchanged."""
+    coeff, criteria, epochs, d = async_result
+    if not isinstance(coeff, jax.Array):  # checkpointed host-driven path
+        return (None if flag is None else float(flag)), coeff[:d], criteria, epochs
+    host = np.asarray(_pack_result(coeff, criteria, epochs, flag=flag))
+    return unpack_train_result(host, d, has_flag=flag is not None)
+
+
 @partial(jax.jit, static_argnames=("loss_func",))
 def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
     """One host-driven epoch over resident batched data — used when
@@ -229,8 +318,36 @@ class SGD:
         mesh: Optional[Mesh] = None,
     ) -> Tuple[np.ndarray, float, int]:
         """Returns (final_coefficient, final_loss, num_epochs)."""
+        result = self.optimize_async(init_coeff, X, y, weights, loss_func, mesh)
+        _, coeff, criteria, epochs = read_train_result(result)
+        return coeff, criteria, epochs
+
+    def optimize_async(
+        self,
+        init_coeff: np.ndarray,
+        X: np.ndarray,
+        y: np.ndarray,
+        weights: Optional[np.ndarray],
+        loss_func: LossFunc,
+        mesh: Optional[Mesh] = None,
+    ):
+        """Dispatch the full training program WITHOUT reading results back.
+
+        Returns (coeff, criteria, epochs, true_dim): device arrays on the
+        non-checkpoint path (coeff may be feature-padded — slice [:true_dim]
+        after readback). Callers should pack everything they need into one
+        array (`_pack_result`) and read it back in a single transfer; on
+        remote-attached TPUs every separate readback is a ~100ms round trip.
+        The checkpointed path is host-driven per epoch and returns host
+        values directly."""
         mesh = mesh or mesh_lib.default_mesh()
         d = np.shape(X)[1]
+        if (
+            not self.shard_features
+            and self.checkpoint_dir is None
+            and mesh_lib.num_data_shards(mesh) == 1
+        ):
+            return self._optimize_flat_async(mesh, init_coeff, X, y, weights, loss_func, d)
         if self.shard_features:
             # zero-pad the feature dim to divide over the model axis; padded
             # coefficients start 0, get zero gradients, and stay 0
@@ -248,7 +365,7 @@ class SGD:
             coeff, criteria, epochs = self._optimize_with_checkpoints(
                 X_b, y_b, w_b, init, loss_func
             )
-            return coeff[:d], criteria, epochs
+            return coeff, criteria, epochs, d
         coeff, criteria, epochs = _sgd_train(
             X_b,
             y_b,
@@ -261,7 +378,7 @@ class SGD:
             jnp.asarray(self.reg, self.dtype),
             jnp.asarray(self.elastic_net, self.dtype),
         )
-        return np.asarray(coeff)[:d], float(criteria), int(epochs)
+        return coeff, criteria, epochs, d
 
     def optimize_stream(
         self,
@@ -429,6 +546,57 @@ class SGD:
             executor.shutdown(wait=True, cancel_futures=True)
             cache.close()
         return np.asarray(coeff), criteria, epoch, stats
+
+    def _optimize_flat_async(self, mesh, init_coeff, X, y, weights, loss_func, d):
+        """Single-data-shard dispatch: no batched re-layout, no weights
+        synthesis program — see `_sgd_train_flat`. Ragged row counts are
+        padded to a batch multiple (the only case that copies). Host inputs
+        are placed on the mesh's device (a 1-device mesh may deliberately
+        pin a fit to a non-default chip); already-device-resident inputs
+        stay where they are."""
+        n = int(np.shape(X)[0])
+        B = int(self.global_batch_size)
+        num_batches = max(1, -(-n // B))
+        n_pad = num_batches * B
+
+        def stage(arr):
+            if arr is None:
+                return None
+            if isinstance(arr, jax.Array):
+                return arr.astype(self.dtype) if arr.dtype != self.dtype else arr
+            arr = np.asarray(arr)
+            return jax.device_put(
+                arr.astype(self.dtype) if arr.dtype != self.dtype else arr,
+                mesh_lib.data_sharding(mesh, arr.ndim),
+            )
+
+        X_f, y_f, w_f = stage(X), stage(y), stage(weights)
+        if y_f is None:
+            y_f = jnp.zeros((n,), self.dtype)
+        if n_pad != n:
+            X_f = jnp.pad(X_f, [(0, n_pad - n), (0, 0)])
+            y_f = jnp.pad(y_f, (0, n_pad - n))
+            if w_f is not None:
+                w_f = jnp.pad(w_f, (0, n_pad - n))
+        has_weights = w_f is not None
+        if not has_weights:
+            w_f = jnp.zeros((0,), self.dtype)
+        coeff, criteria, epochs = _sgd_train_flat(
+            X_f,
+            y_f,
+            w_f,
+            jnp.asarray(np.asarray(init_coeff, self.dtype)),
+            loss_func,
+            B,
+            has_weights,
+            jnp.asarray(n, jnp.int32),
+            jnp.asarray(self.max_iter, jnp.int32),
+            jnp.asarray(self.tol, jnp.float32),
+            jnp.asarray(self.learning_rate, self.dtype),
+            jnp.asarray(self.reg, self.dtype),
+            jnp.asarray(self.elastic_net, self.dtype),
+        )
+        return coeff, criteria, epochs, d
 
     def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func):
         from ..parallel.iteration import (

@@ -132,3 +132,82 @@ class TestLinearSVC:
         model = LinearSVC().set_max_iter(30).fit(_svc_table())
         other = LinearSVCModel().set_model_data(model.get_model_data()[0])
         np.testing.assert_allclose(other.coefficient, model.coefficient)
+
+
+class TestFlatTrainPath:
+    """The single-data-shard fast path (`_sgd_train_flat`) must produce the
+    same coefficients as the batched multi-shard layout (`_sgd_train`) for
+    every padding/weight configuration."""
+
+    def _run(self, mesh, n, with_weights, batch=16):
+        import jax
+
+        from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+        from flink_ml_tpu.ops.optimizer import SGD
+
+        rng = np.random.default_rng(3)
+        X = rng.random((n, 5), dtype=np.float32)
+        y = (X @ np.arange(1, 6, dtype=np.float32) > 7.5).astype(np.float32)
+        w = rng.random(n, dtype=np.float32) if with_weights else None
+        sgd = SGD(max_iter=7, learning_rate=0.05, global_batch_size=batch, tol=0.0)
+        coeff, loss, epochs = sgd.optimize(
+            np.zeros(5, np.float32), X, y, w, BINARY_LOGISTIC_LOSS, mesh=mesh
+        )
+        assert epochs == 7
+        return np.asarray(coeff), loss
+
+    @pytest.mark.parametrize("with_weights", [False, True])
+    @pytest.mark.parametrize("n", [64, 50, 10])  # even, ragged, n < batch
+    def test_matches_batched_layout(self, n, with_weights):
+        from flink_ml_tpu.parallel import mesh as mesh_lib
+
+        mesh1 = mesh_lib.create_mesh(("data",), devices=jax_devices()[:1])
+        coeff_flat, loss_flat = self._run(mesh1, n, with_weights)
+        mesh8 = mesh_lib.create_mesh(("data",))
+        coeff_sharded, loss_sharded = self._run(mesh8, n, with_weights)
+        np.testing.assert_allclose(coeff_flat, coeff_sharded, rtol=2e-5, atol=2e-6)
+        assert abs(loss_flat - loss_sharded) < 1e-5
+
+
+def jax_devices():
+    import jax
+
+    return jax.devices()
+
+
+class TestDeviceLabelValidation:
+    """Device-resident labels take the fused-flag path (run_sgd packs the
+    validity flag into the training result readback) — both outcomes must
+    behave identically to the host-label eager validation."""
+
+    def _table(self, labels):
+        import jax.numpy as jnp
+
+        from flink_ml_tpu.table import Table
+
+        n = len(labels)
+        rng = np.random.default_rng(1)
+        return Table(
+            {
+                "features": jnp.asarray(rng.random((n, 4), dtype=np.float32)),
+                "label": jnp.asarray(np.asarray(labels, np.float32)),
+            }
+        )
+
+    def test_rejects_non_binomial_device_labels(self):
+        from flink_ml_tpu.models.classification.logisticregression import (
+            LogisticRegression,
+        )
+
+        with pytest.raises(ValueError, match="binomial"):
+            LogisticRegression().set_max_iter(2).fit(self._table([0.0, 1.0, 2.0, 1.0]))
+
+    def test_accepts_binomial_device_labels(self):
+        from flink_ml_tpu.models.classification.logisticregression import (
+            LogisticRegression,
+        )
+
+        model = LogisticRegression().set_max_iter(3).fit(
+            self._table([0.0, 1.0, 0.0, 1.0])
+        )
+        assert model.coefficient.shape == (4,)
