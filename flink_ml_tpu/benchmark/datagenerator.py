@@ -17,7 +17,7 @@ import numpy as np
 
 from ..common.param import HasSeed
 from ..param import IntParam, LongParam, Param, ParamValidators
-from ..table import Table
+from ..table import DictTokenMatrix, Table
 
 # Rows at or above this threshold are generated directly in device HBM with
 # jax.random — the analogue of the reference generating data *inside* the
@@ -229,6 +229,14 @@ class LabeledPointWithWeightGenerator(DataGenerator):
         return [Table({names[0]: X, names[1]: y, names[2]: w})]
 
 
+def _string_vocab(m: int) -> np.ndarray:
+    """Decimal token vocabulary at MINIMAL unicode width: astype(str) alone
+    yields '<U21' (84 bytes/element), which makes a 10Mx100 token matrix
+    17GB and string sorting glacial; '<U{digits}' keeps it 8 bytes at
+    m<=100 so the dictionary-encode fast path can view it as int64."""
+    return np.arange(m).astype(str).astype(f"<U{len(str(max(m - 1, 1)))}")
+
+
 class RandomStringGenerator(DataGenerator):
     """Random strings from a fixed-size token universe
     (common/RandomStringGenerator.java)."""
@@ -247,11 +255,13 @@ class RandomStringGenerator(DataGenerator):
         (names,) = self.get_col_names()
         rng = self._rng()
         n, m = self.get_num_values(), self.get_num_distinct_values()
+        # vocab fancy-indexing generates fixed-width unicode columns without
+        # a per-row Python loop (the reference generates rows inside the
+        # cluster; a 10M-iteration host loop here would dominate the stage)
+        vocab = _string_vocab(m)
         cols = {}
         for name in names:
-            cols[name] = np.asarray(
-                [str(v) for v in rng.randint(0, m, size=n)], dtype=object
-            )
+            cols[name] = vocab[rng.randint(0, m, size=n)]
         return [Table(cols)]
 
 
@@ -268,14 +278,25 @@ class RandomStringArrayGenerator(RandomStringGenerator):
 
     def get_data(self) -> List[Table]:
         (names,) = self.get_col_names()
-        rng = self._rng()
         n, m, k = self.get_num_values(), self.get_num_distinct_values(), self.get_array_size()
+        vocab = _string_vocab(m)
         cols = {}
+        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+            # dictionary-encoded, ids born in HBM: string stages compute on
+            # the id matrix device-side (a billion-token host loop on the
+            # single-core driver would dominate every downstream stage)
+            from ..ops import tokens as tokens_ops
+
+            seed = self.get_seed() % (2**32)
+            for i, name in enumerate(names):
+                ids = tokens_ops.random_token_ids(seed + i, n, k, m)
+                cols[name] = DictTokenMatrix(vocab, ids)
+            return [Table(cols)]
+        rng = self._rng()
         for name in names:
-            col = np.empty(n, dtype=object)
-            for i in range(n):
-                col[i] = [str(v) for v in rng.randint(0, m, size=k)]
-            cols[name] = col
+            # (n, k) fixed-width unicode token matrix — the columnar layout
+            # string stages consume vectorized (each row is one token array)
+            cols[name] = vocab[rng.randint(0, m, size=(n, k))]
         return [Table(cols)]
 
 

@@ -21,6 +21,8 @@ import json
 import re
 import sys
 import time
+
+import numpy as np
 from typing import Dict, List, Optional
 
 from ..api import AlgoOperator, Estimator, Model
@@ -128,14 +130,31 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
 
 def _block_until_ready(tables: List[Table]) -> None:
     """Force device-resident columns to completion so phase timings measure
-    real work, not async dispatch."""
+    real work, not async dispatch. On remote-attached TPUs
+    `block_until_ready` can return before the queue drains, so the reliable
+    barrier is a scalar READBACK of a probe value that depends on every
+    device column (one host round trip total) — including device arrays
+    nested inside SparseBatch and DictTokenMatrix columns."""
     import jax
+    import jax.numpy as jnp
 
+    from ..table import DictTokenMatrix, SparseBatch
+
+    probes = []
     for t in tables:
         for name in t.column_names:
             col = t.column(name)
-            if isinstance(col, jax.Array):
-                col.block_until_ready()
+            if isinstance(col, SparseBatch):
+                arrs = (col.indices, col.values)
+            elif isinstance(col, DictTokenMatrix):
+                arrs = (col.ids,)
+            else:
+                arrs = (col,)
+            for arr in arrs:
+                if isinstance(arr, jax.Array):
+                    probes.append(arr[(0,) * arr.ndim].astype(jnp.float32))
+    if probes:
+        np.asarray(jnp.stack(probes))
 
 
 def execute_benchmarks(config: Dict) -> Dict[str, Dict]:

@@ -18,9 +18,10 @@ import numpy as np
 from ...api import Estimator, Model
 from ...common.param import HasInputCol, HasOutputCol
 from ...param import BooleanParam, DoubleParam, IntParam, ParamValidators
-from ...table import Table, rows_to_sparse_batch
+from ...table import DictTokenMatrix, SparseBatch, Table, rows_to_sparse_batch
 from ...utils import read_write
 from ...utils.param_utils import update_existing_params
+from . import _tokens
 
 
 class CountVectorizerModelParams(HasInputCol, HasOutputCol):
@@ -107,6 +108,48 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         binary = self.get_binary()
         col = table.column(self.get_input_col())
         size = len(self.vocabulary)
+        if isinstance(col, DictTokenMatrix):
+            # dictionary-encoded path: vocab remap is a small host lut, the
+            # per-row counting runs on device (sort + run lengths), and the
+            # sparse output STAYS on device
+            import jax
+
+            from ...ops import tokens as tokens_ops
+
+            import jax.numpy as jnp
+
+            lut = jax.device_put(
+                _tokens.lookup(col.vocab, index).astype(np.int32)
+            )
+            if min_tf >= 1.0:
+                thr = jnp.full((col.n,), min_tf, jnp.float32)
+            else:
+                valid = (jnp.asarray(col.ids) >= 0).sum(axis=1)
+                thr = (min_tf * valid).astype(jnp.float32)
+            indices, values = tokens_ops.map_term_runs_chunked(
+                col.ids, lut, thr, binary=binary
+            )
+            return [
+                table.with_column(
+                    self.get_output_col(), SparseBatch(size, indices, values)
+                )
+            ]
+        A = _tokens.token_matrix(col)
+        if A is not None:  # columnar path: dictionary-encode + run counts
+            uniq, ids = _tokens.encode(A)
+            vocab_ids = _tokens.lookup(uniq, index)[ids]  # (n, k), -1 = OOV
+            rows, values, counts = _tokens.row_run_counts(vocab_ids)
+            threshold = min_tf if min_tf >= 1.0 else min_tf * A.shape[1]
+            keep = counts >= threshold
+            rows, values, counts = rows[keep], values[keep], counts[keep]
+            if binary:
+                counts = np.ones_like(counts, np.float64)
+            return [
+                table.with_column(
+                    self.get_output_col(),
+                    _tokens.sparse_from_runs(A.shape[0], size, rows, values, counts),
+                )
+            ]
         row_idx, row_val = [], []
         for tokens in col:
             tokens = list(tokens)
@@ -136,18 +179,42 @@ class CountVectorizer(Estimator, CountVectorizerParams):
         (table,) = inputs
         col = table.column(self.get_input_col())
         n_docs = len(col)
-        tf = Counter()
-        df = Counter()
-        for tokens in col:
-            tokens = list(tokens)
-            tf.update(tokens)
-            df.update(set(tokens))
         min_df = self.get_min_df()
         max_df = self.get_max_df()
         min_count = min_df if min_df >= 1.0 else min_df * n_docs
         max_count = max_df if max_df >= 1.0 else max_df * n_docs
-        terms = [t for t in tf if min_count <= df[t] <= max_count]
-        terms.sort(key=lambda t: (-tf[t], t))
+        if isinstance(col, DictTokenMatrix):
+            # dictionary-encoded path: tf/df are one device bincount pass
+            # over the id matrix, read back in a single packed transfer
+            from ...ops import tokens as tokens_ops
+
+            u = len(col.vocab)
+            tf_df = np.asarray(tokens_ops.term_counts_chunked(col.ids, u))
+            tf_arr, df_arr = tf_df[0], tf_df[1]
+            # df > 0 excludes dictionary entries absent from the corpus
+            # (e.g. stop words filtered upstream of an unchanged vocab) —
+            # the row paths only ever see observed terms
+            keep = (df_arr >= min_count) & (df_arr <= max_count) & (df_arr > 0)
+            order = np.lexsort((col.vocab, -tf_arr))
+            terms = [str(col.vocab[i]) for i in order if keep[i]]
+        elif (A := _tokens.token_matrix(col)) is not None:
+            # columnar host path: corpus tf/df as bincounts
+            uniq, ids = _tokens.encode(A)
+            tf_arr = np.bincount(ids.ravel(), minlength=len(uniq))
+            doc_rows, doc_vals, _ = _tokens.row_run_counts(ids)
+            df_arr = np.bincount(doc_vals, minlength=len(uniq))
+            keep = (df_arr >= min_count) & (df_arr <= max_count)
+            order = np.lexsort((uniq, -tf_arr))  # by (-tf, term asc)
+            terms = [str(uniq[i]) for i in order if keep[i]]
+        else:
+            tf = Counter()
+            df = Counter()
+            for tokens in col:
+                tokens = list(tokens)
+                tf.update(tokens)
+                df.update(set(tokens))
+            terms = [t for t in tf if min_count <= df[t] <= max_count]
+            terms.sort(key=lambda t: (-tf[t], t))
         model = CountVectorizerModel()
         model.vocabulary = terms[: self.get_vocabulary_size()]
         update_existing_params(model, self)

@@ -17,8 +17,9 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCol, HasNumFeatures, HasOutputCol
 from ...param import BooleanParam
-from ...table import Table, rows_to_sparse_batch
+from ...table import DictTokenMatrix, SparseBatch, Table, rows_to_sparse_batch
 from ...utils.hashing import hash_term
+from . import _tokens
 
 
 class HashingTFParams(HasInputCol, HasOutputCol, HasNumFeatures):
@@ -39,6 +40,47 @@ class HashingTF(Transformer, HashingTFParams):
         col = table.column(self.get_input_col())
         n_features = self.get_num_features()
         binary = self.get_binary()
+        if isinstance(col, DictTokenMatrix):
+            # dictionary-encoded path: hash only the (small) vocab on host,
+            # bucket-map + per-row counting on device; output stays there
+            import jax
+            import jax.numpy as jnp
+
+            from ...ops import tokens as tokens_ops
+
+            lut = jax.device_put(
+                np.asarray(
+                    [hash_term(str(t)) % n_features for t in col.vocab], np.int32
+                )
+            )
+            thr = jnp.ones((col.n,), jnp.float32)
+            indices, values = tokens_ops.map_term_runs_chunked(
+                col.ids, lut, thr, binary=binary
+            )
+            return [
+                table.with_column(
+                    self.get_output_col(), SparseBatch(n_features, indices, values)
+                )
+            ]
+        A = _tokens.token_matrix(col)
+        if A is not None:
+            # columnar path: hash each DISTINCT term once, gather bucket ids,
+            # then per-row run counts (equal buckets merge, incl. collisions)
+            uniq, ids = _tokens.encode(A)
+            buckets = np.asarray(
+                [hash_term(str(t)) % n_features for t in uniq], np.int32
+            )
+            rows, values, counts = _tokens.row_run_counts(buckets[ids])
+            if binary:
+                counts = np.ones_like(counts, np.float64)
+            return [
+                table.with_column(
+                    self.get_output_col(),
+                    _tokens.sparse_from_runs(
+                        A.shape[0], n_features, rows, values, counts
+                    ),
+                )
+            ]
         row_indices: List[List[int]] = []
         row_values: List[List[float]] = []
         for terms in col:

@@ -13,7 +13,8 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCol, HasOutputCol
 from ...param import IntParam, ParamValidators
-from ...table import Table
+from ...table import DictTokenMatrix, Table
+from . import _tokens
 
 
 class NGramParams(HasInputCol, HasOutputCol):
@@ -31,6 +32,43 @@ class NGram(Transformer, NGramParams):
         (table,) = inputs
         n = self.get_n()
         col = table.column(self.get_input_col())
+        if isinstance(col, DictTokenMatrix):
+            u = len(col.vocab)
+            if col.k < n:
+                out = np.empty(len(col), dtype=object)
+                out[:] = [[] for _ in range(len(col))]
+                return [table.with_column(self.get_output_col(), out)]
+            if u**n <= 4_000_000:
+                # dictionary path: gram codes on device, gram vocab = the
+                # u^n joined combinations built once on host
+                from ...ops import tokens as tokens_ops
+
+                codes = tokens_ops.ngram_codes(col.ids, u, n)
+                vocab = tokens_ops.ngram_vocab(col.vocab, n)
+                return [
+                    table.with_column(
+                        self.get_output_col(), DictTokenMatrix(vocab, codes)
+                    )
+                ]
+            col = col.to_object_column()  # vocab blow-up: per-row fallback
+        A = _tokens.token_matrix(col)
+        if A is not None:
+            # columnar path: n-gram j = join of columns j..j+n-1; output is
+            # another fixed-width token matrix (k - n + 1 grams per row)
+            k = A.shape[1]
+            if k < n:
+                out = np.empty(len(col), dtype=object)
+                out[:] = [[] for _ in range(len(col))]
+                return [table.with_column(self.get_output_col(), out)]
+            grams = []
+            for j in range(k - n + 1):
+                g = A[:, j]
+                for t in range(1, n):
+                    g = np.char.add(np.char.add(g, " "), A[:, j + t])
+                grams.append(g)
+            return [
+                table.with_column(self.get_output_col(), np.stack(grams, axis=1))
+            ]
         out = np.empty(len(col), dtype=object)
         for i, tokens in enumerate(col):
             tokens = list(tokens)

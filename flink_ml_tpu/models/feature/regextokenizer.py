@@ -64,13 +64,23 @@ class RegexTokenizer(Transformer, RegexTokenizerParams):
         min_len = self.get_min_token_length()
         lower = self.get_to_lowercase()
         col = table.column(self.get_input_col())
-        out = np.empty(len(col), dtype=object)
-        for i, s in enumerate(col):
-            text = str(s).lower() if lower else str(s)
+
+        def tokenize(s: str) -> list:
+            text = s.lower() if lower else s
             if gaps:
                 tokens = pattern.split(text)
             else:
                 # full matches, not capture groups (RegexTokenizer.java matcher.group())
                 tokens = [m.group(0) for m in pattern.finditer(text)]
-            out[i] = [t for t in tokens if len(t) >= min_len]
+            return [t for t in tokens if len(t) >= min_len]
+
+        from . import _tokens
+
+        S = _tokens.string_column(col)
+        if S is not None:  # tokenize each DISTINCT string once, gather by id
+            out = _tokens.map_rows_by_unique(S, tokenize)
+        else:
+            out = np.empty(len(col), dtype=object)
+            for i, s in enumerate(col):
+                out[i] = tokenize(str(s))
         return [table.with_column(self.get_output_col(), out)]

@@ -17,7 +17,8 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCols, HasOutputCols
 from ...param import BooleanParam, ParamValidators, StringArrayParam, StringParam
-from ...table import Table
+from ...table import DictTokenMatrix, Table
+from . import _tokens
 from ._stopwords import STOP_WORDS
 
 
@@ -90,8 +91,31 @@ class StopWordsRemover(Transformer, StopWordsRemoverParams):
         if not case_sensitive:
             stop = {w.lower() for w in stop}
         updates = {}
+        stop_arr = np.asarray(sorted(stop))
         for name, out_name in zip(in_cols, out_cols):
             col = table.column(name)
+            if isinstance(col, DictTokenMatrix):
+                # dictionary path: one (small) keep-mask over the vocab on
+                # host, token filtering on device; stays dictionary-encoded
+                import jax
+
+                from ...ops import tokens as tokens_ops
+
+                if case_sensitive:
+                    keep_vocab = ~np.isin(col.vocab, stop_arr)
+                else:
+                    keep_vocab = ~np.isin(np.char.lower(col.vocab.astype(str)), stop_arr)
+                new_ids = tokens_ops.filter_tokens_chunked(
+                    col.ids, jax.device_put(keep_vocab)
+                )
+                updates[out_name] = DictTokenMatrix(col.vocab, new_ids)
+                continue
+            A = _tokens.token_matrix(col)
+            if A is not None:  # columnar path: one isin over the matrix
+                probe = A if case_sensitive else np.char.lower(A)
+                keep = ~np.isin(probe, stop_arr)
+                updates[out_name] = _tokens.ragged_from_mask(A, keep)
+                continue
             out = np.empty(len(col), dtype=object)
             for i, tokens in enumerate(col):
                 if case_sensitive:

@@ -122,6 +122,29 @@ class StringIndexerModel(Model, StringIndexerModelParams):
             mapping = {s: float(i) for i, s in enumerate(strings)}
             unseen = float(len(strings))
             col = table.column(name)
+            if isinstance(col, np.ndarray) and col.ndim == 1 and col.dtype.kind in "US":
+                # columnar string path: look each DISTINCT value up once
+                uniq, inv = np.unique(col, return_inverse=True)
+                uniq_out = np.empty(len(uniq), dtype=np.float64)
+                uniq_bad = np.zeros(len(uniq), dtype=bool)
+                for j, u in enumerate(uniq):
+                    key = str(u)
+                    if key in mapping:
+                        uniq_out[j] = mapping[key]
+                    elif handle == HasHandleInvalid.KEEP_INVALID:
+                        uniq_out[j] = unseen
+                    elif handle == HasHandleInvalid.SKIP_INVALID:
+                        uniq_out[j] = np.nan
+                        uniq_bad[j] = True
+                    else:
+                        raise ValueError(
+                            f"The input contains unseen string: {key}. See "
+                            "handleInvalid parameter for more options."
+                        )
+                inv = inv.reshape(-1)
+                updates[out_name] = uniq_out[inv]
+                drop_mask |= uniq_bad[inv]
+                continue
             out = np.empty(len(col), dtype=np.float64)
             for i, v in enumerate(col):
                 key = _to_string(v)
@@ -213,7 +236,12 @@ class StringIndexer(Estimator, StringIndexerParams):
         string_arrays: List[List[str]] = []
         for name in self.get_input_cols():
             col = table.column(name)
-            counts = Counter(_to_string(v) for v in col)
+            if isinstance(col, np.ndarray) and col.ndim == 1 and col.dtype.kind in "US":
+                # columnar string path: one np.unique instead of a host loop
+                uniq, cnt = np.unique(col, return_counts=True)
+                counts = Counter(dict(zip((str(u) for u in uniq), cnt)))
+            else:
+                counts = Counter(_to_string(v) for v in col)
             if order in (ARBITRARY_ORDER, ALPHABET_ASC_ORDER):
                 strings = sorted(counts)
             elif order == ALPHABET_DESC_ORDER:

@@ -15,22 +15,31 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCol, HasOutputCol
 from ...table import Table
+from . import _tokens
 
 
 class TokenizerParams(HasInputCol, HasOutputCol):
     pass
 
 
+def _split_one(s: str) -> list:
+    # Java String.split("\\s") keeps empty tokens between separators but
+    # drops trailing empties.
+    tokens = re.split(r"\s", s.lower())
+    while tokens and tokens[-1] == "":
+        tokens.pop()
+    return tokens
+
+
 class Tokenizer(Transformer, TokenizerParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         col = table.column(self.get_input_col())
-        out = np.empty(len(col), dtype=object)
-        for i, s in enumerate(col):
-            # Java String.split("\\s") keeps empty tokens between separators
-            # but drops trailing empties.
-            tokens = re.split(r"\s", str(s).lower())
-            while tokens and tokens[-1] == "":
-                tokens.pop()
-            out[i] = tokens
+        S = _tokens.string_column(col)
+        if S is not None:  # split each DISTINCT string once, gather by id
+            out = _tokens.map_rows_by_unique(S, _split_one)
+        else:
+            out = np.empty(len(col), dtype=object)
+            for i, s in enumerate(col):
+                out[i] = _split_one(str(s))
         return [table.with_column(self.get_output_col(), out)]

@@ -1,0 +1,201 @@
+"""Device-side kernels for dictionary-encoded token columns.
+
+The compute core behind the string feature stages when a column is a
+`DictTokenMatrix` (small host vocab + (n, k) int32 id matrix on device).
+The reference implements these as per-row Java map operators over String[]
+values (feature/countvectorizer/CountVectorizer.java,
+feature/hashingtf/HashingTF.java:125-185, feature/ngram/NGram.java,
+feature/stopwordsremover/StopWordsRemover.java); on a TPU the same
+semantics are bincounts, per-row sorts, and gathers over the id matrix —
+a billion tokens is milliseconds of VPU work instead of minutes of
+single-core host string handling.
+
+id -1 is the absent-token sentinel throughout (ragged rows).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("num_terms",))
+def term_counts(ids, num_terms):
+    """Corpus term frequency + document frequency per vocab id, packed as
+    one (2, num_terms) array so the host reads both back in a single
+    transfer (remote-TPU readbacks cost a full round trip each).
+
+    tf[v] = total occurrences of v; df[v] = number of rows containing v
+    (CountVectorizer.java fit-side aggregation). df comes from a per-row
+    sort + first-occurrence bincount: transient memory is O(n*k),
+    independent of vocab size (a dense (rows, vocab) membership matrix
+    would OOM on n-gram-sized vocabularies).
+    """
+    n, k = ids.shape
+    safe = jnp.where(ids >= 0, ids, num_terms)  # -1 -> overflow slot
+    tf = jnp.bincount(safe.ravel(), length=num_terms + 1)[:num_terms]
+    S = jnp.sort(safe, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((n, 1), jnp.bool_), S[:, 1:] != S[:, :-1]], axis=1
+    )
+    df = jnp.bincount(
+        jnp.where(first, S, num_terms).ravel(), length=num_terms + 1
+    )[:num_terms]
+    return jnp.stack([tf, df]).astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("binary",))
+def row_term_runs(mapped, thr_row, binary=False):
+    """Per-row (term, count) runs over a mapped id matrix, as padded-CSR
+    (indices, values) with -1 padding — the SparseBatch layout.
+
+    `mapped`: (n, k) int32, -1 = skip (OOV / absent). Each row's output
+    lists its distinct non-negative terms ascending with their counts;
+    runs whose count < thr_row[row] are dropped (minTF); `binary` caps
+    values at 1 (CountVectorizerModelParams/HashingTFParams binary).
+    """
+    n, k = mapped.shape
+    big = jnp.int32(2**31 - 1)
+    S = jnp.sort(jnp.where(mapped >= 0, mapped, big), axis=1)
+    idxs = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k))
+    first = jnp.concatenate(
+        [jnp.ones((n, 1), jnp.bool_), S[:, 1:] != S[:, :-1]], axis=1
+    )
+    first_pos = jnp.where(first, idxs, k)
+    # next run start after p = min(first_pos[p+1:]) — suffix-min via
+    # reversed cumulative min
+    suffix_min = lax.cummin(first_pos[:, ::-1], axis=1)[:, ::-1]
+    next_first = jnp.concatenate(
+        [suffix_min[:, 1:], jnp.full((n, 1), k, first_pos.dtype)], axis=1
+    )
+    runlen = (next_first - idxs).astype(jnp.int32)
+    kept = first & (S != big) & (runlen >= thr_row[:, None])
+    # compact kept runs to the left, preserving ascending term order
+    order = jnp.argsort(jnp.where(kept, idxs, k), axis=1, stable=True)
+    indices = jnp.take_along_axis(jnp.where(kept, S, -1), order, axis=1)
+    counts = jnp.where(kept, jnp.int32(1) if binary else runlen, 0)
+    values = jnp.take_along_axis(counts, order, axis=1).astype(jnp.float32)
+    return indices, values
+
+
+CHUNK_ROWS = 1_000_000
+"""Row-chunk size for the host-chunked drivers below: the whole-matrix
+programs materialize several (n, k) int32 temps (iota/sort/argsort), which
+OOMs 16GB HBM around n*k = 1e9 — chunking bounds transients to ~2GB while
+dispatches still pipeline (one readback at the end)."""
+
+
+def term_counts_chunked(ids, num_terms, chunk_rows: int = CHUNK_ROWS):
+    """`term_counts` over row chunks, accumulated on device."""
+    n = ids.shape[0]
+    if n <= chunk_rows:
+        return term_counts(ids, num_terms)
+    total = None
+    for s in range(0, n, chunk_rows):
+        c = term_counts(ids[s : s + chunk_rows], num_terms)
+        total = c if total is None else total + c
+    return total
+
+
+@partial(jax.jit, static_argnames=("binary",))
+def _map_and_runs(ids, lut, thr_row, binary=False):
+    """gather_map fused with row_term_runs so the mapped matrix exists only
+    as a chunk-local temp, never as a full (n, k) allocation."""
+    return row_term_runs(gather_map(ids, lut), thr_row, binary=binary)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _paste(buf, part, start):
+    """Donated in-place chunk write: XLA aliases buf instead of copying the
+    whole output per chunk (a jnp.concatenate of all chunks would briefly
+    hold 2x the output in HBM)."""
+    return lax.dynamic_update_slice_in_dim(buf, part, start, 0)
+
+
+def map_term_runs_chunked(ids, lut, thr_row, binary=False, chunk_rows: int = CHUNK_ROWS):
+    """lut-map + `row_term_runs` over row chunks, pasted into preallocated
+    output buffers. Peak HBM = input + output + O(chunk) — the fused chunk
+    program never materializes the full mapped matrix, and the donated
+    paste never duplicates the output."""
+    n, k = ids.shape
+    if n <= chunk_rows:
+        return _map_and_runs(ids, lut, thr_row, binary=binary)
+    indices = jnp.full((n, k), -1, jnp.int32)
+    values = jnp.zeros((n, k), jnp.float32)
+    for s in range(0, n, chunk_rows):
+        pi, pv = _map_and_runs(
+            ids[s : s + chunk_rows], lut, thr_row[s : s + chunk_rows], binary=binary
+        )
+        indices = _paste(indices, pi, s)
+        values = _paste(values, pv, s)
+    return indices, values
+
+
+@jax.jit
+def gather_map(ids, lut):
+    """Map ids through a lookup table; -1 stays -1 (absent/OOV)."""
+    return jnp.where(ids >= 0, lut[jnp.where(ids >= 0, ids, 0)], -1)
+
+
+@jax.jit
+def filter_tokens(ids, keep_vocab):
+    """Drop tokens whose vocab id is masked out, compacting survivors left
+    and padding with -1 — order preserved (StopWordsRemover semantics)."""
+    n, k = ids.shape
+    idxs = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k))
+    keep = (ids >= 0) & keep_vocab[jnp.where(ids >= 0, ids, 0)]
+    order = jnp.argsort(jnp.where(keep, idxs, k), axis=1, stable=True)
+    return jnp.take_along_axis(jnp.where(keep, ids, -1), order, axis=1)
+
+
+def filter_tokens_chunked(ids, keep_vocab, chunk_rows: int = CHUNK_ROWS):
+    """`filter_tokens` over row chunks with donated pastes — same transient
+    bound as the other chunked drivers (argsort temps are several times the
+    chunk, so a whole 1e9-id matrix would OOM in one program)."""
+    n, k = ids.shape
+    if n <= chunk_rows:
+        return filter_tokens(ids, keep_vocab)
+    out = jnp.full((n, k), -1, jnp.int32)
+    for s in range(0, n, chunk_rows):
+        out = _paste(out, filter_tokens(ids[s : s + chunk_rows], keep_vocab), s)
+    return out
+
+
+@partial(jax.jit, static_argnames=("num_terms", "gram"))
+def ngram_codes(ids, num_terms, gram):
+    """Combine adjacent token ids into base-`num_terms` n-gram codes:
+    code = ids[j]*u^(g-1) + ... + ids[j+g-1]. Rows shorter than the window
+    (any absent component) produce -1 (NGram.java: inputs shorter than n
+    give an empty array)."""
+    n, k = ids.shape
+    out_k = k - gram + 1
+    code = jnp.zeros((n, out_k), jnp.int64)
+    valid = jnp.ones((n, out_k), jnp.bool_)
+    for t in range(gram):
+        part = ids[:, t : t + out_k]
+        valid &= part >= 0
+        code = code * num_terms + jnp.where(part >= 0, part, 0)
+    return jnp.where(valid, code, -1).astype(jnp.int64)
+
+
+def ngram_vocab(vocab: np.ndarray, gram: int) -> np.ndarray:
+    """Host-side n-gram vocabulary in code order: entry for code c is the
+    space-joined terms of c's base-u digits. Size u^gram — callers guard
+    against explosion before calling."""
+    u = len(vocab)
+    grams = vocab.astype(object)
+    for _ in range(gram - 1):
+        grams = np.char.add(np.char.add(grams[:, None].astype(str), " "), vocab[None, :].astype(str)).ravel()
+        grams = grams.astype(object)
+    width = (np.char.str_len(vocab.astype(str)).max() + 1) * gram
+    return grams.astype(f"<U{width}")
+
+
+def random_token_ids(seed: int, n: int, k: int, num_terms: int):
+    """Device-born random token id matrix (benchmark datagen path)."""
+    key = jax.random.PRNGKey(seed)
+    return jax.random.randint(key, (n, k), 0, num_terms, dtype=jnp.int32)

@@ -19,7 +19,76 @@ import numpy as np
 
 from .linalg import DenseVector, SparseVector, Vector
 
-__all__ = ["Table", "StreamTable", "SparseBatch", "as_dense_matrix", "as_sparse_batch"]
+
+def _is_jax_array(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+__all__ = [
+    "Table",
+    "StreamTable",
+    "SparseBatch",
+    "DictTokenMatrix",
+    "as_dense_matrix",
+    "as_sparse_batch",
+]
+
+
+class DictTokenMatrix:
+    """Dictionary-encoded token-array column: a small host `vocab` (unicode
+    array) plus an (n, k) int32 `ids` matrix that may live on device.
+
+    The TPU-native layout for string-array columns: the reference streams
+    per-row String[] values (e.g. into CountVectorizer.java / HashingTF.java
+    map operators); a single-core host touching 1e9 token strings is
+    minutes of work, so columns are encoded ONCE and every string stage
+    computes on the id matrix (bincounts, sorts, gathers — MXU/VPU work
+    when `ids` is a jax array). id -1 is the absent-token sentinel, which
+    makes the layout ragged-capable (StopWordsRemover emits it).
+    """
+
+    __slots__ = ("vocab", "ids")
+
+    def __init__(self, vocab, ids):
+        self.vocab = np.asarray(vocab)
+        self.ids = ids  # np.ndarray or jax.Array, (n, k) integer
+
+    @property
+    def n(self) -> int:
+        return int(self.ids.shape[0])
+
+    @property
+    def k(self) -> int:
+        return int(self.ids.shape[1])
+
+    def __len__(self):
+        return self.n
+
+    def host_ids(self) -> np.ndarray:
+        return np.asarray(self.ids)
+
+    def row(self, i: int) -> list:
+        ids = np.asarray(self.ids[i])
+        return [str(self.vocab[j]) for j in ids if j >= 0]
+
+    def to_object_column(self) -> np.ndarray:
+        """Materialize per-row token lists (host path / collect())."""
+        ids = self.host_ids()
+        out = np.empty(ids.shape[0], dtype=object)
+        vocab = self.vocab
+        for i in range(ids.shape[0]):
+            row = ids[i]
+            out[i] = [str(vocab[j]) for j in row if j >= 0]
+        return out
+
+    def __repr__(self):
+        return (
+            f"DictTokenMatrix(n={self.n}, k={self.k}, vocab={len(self.vocab)})"
+        )
 
 
 class SparseBatch:
@@ -34,9 +103,15 @@ class SparseBatch:
 
     def __init__(self, size: int, indices, values):
         self.size = int(size)
-        self.indices = np.asarray(indices, dtype=np.int32)
-        self.values = np.asarray(values, dtype=np.float64)
-        if self.indices.shape != self.values.shape or self.indices.ndim != 2:
+        # device-resident (jax) index/value arrays stay on device — pulling
+        # a 10M-row sparse output to the host would undo the device compute
+        if _is_jax_array(indices) or _is_jax_array(values):
+            self.indices = indices
+            self.values = values
+        else:
+            self.indices = np.asarray(indices, dtype=np.int32)
+            self.values = np.asarray(values, dtype=np.float64)
+        if tuple(self.indices.shape) != tuple(self.values.shape) or self.indices.ndim != 2:
             raise ValueError("SparseBatch requires matching (n, k) indices/values")
 
     @property
@@ -44,14 +119,16 @@ class SparseBatch:
         return int(self.indices.shape[0])
 
     def to_dense(self) -> np.ndarray:
+        indices, values = np.asarray(self.indices), np.asarray(self.values)
         out = np.zeros((self.n, self.size), dtype=np.float64)
-        rows, cols = np.nonzero(self.indices >= 0)
-        out[rows, self.indices[rows, cols]] = self.values[rows, cols]
+        rows, cols = np.nonzero(indices >= 0)
+        out[rows, indices[rows, cols]] = values[rows, cols]
         return out
 
     def row(self, i: int) -> SparseVector:
-        mask = self.indices[i] >= 0
-        return SparseVector(self.size, self.indices[i][mask], self.values[i][mask])
+        indices, values = np.asarray(self.indices[i]), np.asarray(self.values[i])
+        mask = indices >= 0
+        return SparseVector(self.size, indices[mask], values[mask])
 
     def __len__(self):
         return self.n
@@ -59,7 +136,7 @@ class SparseBatch:
 
 def _normalize_column(values: Any):
     """Normalize a user-provided column into an internal representation."""
-    if isinstance(values, (np.ndarray, SparseBatch)):
+    if isinstance(values, (np.ndarray, SparseBatch, DictTokenMatrix)):
         return values
     try:
         import jax
@@ -106,6 +183,43 @@ def _sparse_vectors_to_batch(vectors: Sequence[SparseVector]) -> SparseBatch:
     return SparseBatch(size, indices, values)
 
 
+def _token_matrix_to_object(A: np.ndarray) -> np.ndarray:
+    out = np.empty(A.shape[0], dtype=object)
+    for i in range(A.shape[0]):
+        out[i] = [str(t) for t in A[i]]
+    return out
+
+
+def _as_dict_tokens(col) -> "DictTokenMatrix":
+    if isinstance(col, DictTokenMatrix):
+        return col
+    A = np.asarray(col)
+    if A.ndim == 2 and A.dtype.kind in "US":
+        uniq, inv = np.unique(A, return_inverse=True)
+        return DictTokenMatrix(uniq, inv.reshape(A.shape).astype(np.int32))
+    raise ValueError(
+        f"Cannot concatenate token column with incompatible column {type(col).__name__}"
+    )
+
+
+def _concat_token_columns(a, b) -> "DictTokenMatrix":
+    """Concat two token columns as one DictTokenMatrix: union the vocabs,
+    remap ids, pad the narrower matrix with the -1 sentinel."""
+    da, db = _as_dict_tokens(a), _as_dict_tokens(b)
+    vocab = np.union1d(da.vocab.astype(str), db.vocab.astype(str))
+
+    def remap(d: "DictTokenMatrix"):
+        lut = np.searchsorted(vocab, d.vocab.astype(str)).astype(np.int32)
+        ids = d.host_ids()
+        return np.where(ids >= 0, lut[np.where(ids >= 0, ids, 0)], -1).astype(np.int32)
+
+    ia, ib = remap(da), remap(db)
+    k = max(ia.shape[1], ib.shape[1])
+    ia = np.pad(ia, ((0, 0), (0, k - ia.shape[1])), constant_values=-1)
+    ib = np.pad(ib, ((0, 0), (0, k - ib.shape[1])), constant_values=-1)
+    return DictTokenMatrix(vocab, np.concatenate([ia, ib]))
+
+
 class Table:
     """A bounded, named-column table."""
 
@@ -114,7 +228,11 @@ class Table:
         n = None
         for name, values in data.items():
             col = _normalize_column(values)
-            rows = len(col) if isinstance(col, SparseBatch) else int(np.shape(col)[0])
+            rows = (
+                len(col)
+                if isinstance(col, (SparseBatch, DictTokenMatrix))
+                else int(np.shape(col)[0])
+            )
             if n is None:
                 n = rows
             elif rows != n:
@@ -182,6 +300,8 @@ class Table:
         for name, col in self._columns.items():
             if isinstance(col, SparseBatch):
                 out[name] = SparseBatch(col.size, col.indices[indices], col.values[indices])
+            elif isinstance(col, DictTokenMatrix):
+                out[name] = DictTokenMatrix(col.vocab, col.ids[indices])
             else:
                 out[name] = col[indices]
         return Table(out)
@@ -193,7 +313,19 @@ class Table:
         out = {}
         for name in self.column_names:
             a, b = self._columns[name], other.column(name)
-            if isinstance(a, SparseBatch):
+            if isinstance(a, DictTokenMatrix) or isinstance(b, DictTokenMatrix):
+                out[name] = _concat_token_columns(a, b)
+            elif (
+                isinstance(a, np.ndarray)
+                and a.ndim == 2
+                and a.dtype.kind in "US"
+                and a.shape[1] != np.shape(b)[1]
+            ):
+                # token matrices of different widths: fall back to ragged
+                out[name] = np.concatenate(
+                    [_token_matrix_to_object(a), _token_matrix_to_object(np.asarray(b))]
+                )
+            elif isinstance(a, SparseBatch):
                 if a.size != b.size:
                     raise ValueError("SparseBatch size mismatch in concat")
                 k = max(a.indices.shape[1], b.indices.shape[1])
@@ -221,12 +353,14 @@ class Table:
         for i in range(self._num_rows):
             row = {}
             for name, col in self._columns.items():
-                if isinstance(col, SparseBatch):
+                if isinstance(col, (SparseBatch, DictTokenMatrix)):
                     row[name] = col.row(i)
                 else:
                     v = col[i]
                     if isinstance(v, np.ndarray) and v.ndim == 1:
-                        v = DenseVector(v)
+                        # numeric row-vectors surface as DenseVector; token
+                        # matrix rows surface as their token list
+                        v = v.tolist() if v.dtype.kind in "US" else DenseVector(v)
                     row[name] = v
             yield row
 

@@ -1,0 +1,337 @@
+"""Columnar (fixed-width unicode matrix) vs per-row (object array) parity
+for the string feature stages — both layouts must produce identical
+outputs, mirroring the reference's single row-at-a-time semantics
+(feature/countvectorizer/CountVectorizer.java, hashingtf/HashingTF.java,
+ngram/NGram.java, stopwordsremover/StopWordsRemover.java,
+stringindexer/StringIndexer.java)."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.table import SparseBatch, Table
+
+
+def _object_col(matrix):
+    out = np.empty(matrix.shape[0], dtype=object)
+    for i, row in enumerate(matrix):
+        out[i] = [str(t) for t in row]
+    return out
+
+
+def _rand_matrix(n=50, k=8, m=12, seed=0):
+    rng = np.random.RandomState(seed)
+    vocab = np.arange(m).astype(str)
+    return vocab[rng.randint(0, m, size=(n, k))]
+
+
+def _sparse_rows(col):
+    assert isinstance(col, SparseBatch)
+    rows = []
+    for i in range(col.n):
+        mask = col.indices[i] >= 0
+        rows.append(
+            (col.indices[i][mask].tolist(), col.values[i][mask].tolist())
+        )
+    return rows
+
+
+class TestCountVectorizerParity:
+    @pytest.mark.parametrize("binary", [False, True])
+    @pytest.mark.parametrize("min_tf", [1.0, 2.0, 0.2])
+    def test_fit_transform(self, binary, min_tf):
+        from flink_ml_tpu.models.feature.countvectorizer import CountVectorizer
+
+        A = _rand_matrix()
+        cv = (
+            CountVectorizer()
+            .set_input_col("tokens")
+            .set_output_col("vec")
+            .set_binary(binary)
+            .set_min_tf(min_tf)
+            .set_min_df(2.0)
+        )
+        m_mat = cv.fit(Table({"tokens": A}))
+        m_obj = cv.fit(Table({"tokens": _object_col(A)}))
+        assert m_mat.vocabulary == m_obj.vocabulary
+        out_mat = m_mat.transform(Table({"tokens": A}))[0].column("vec")
+        out_obj = m_obj.transform(Table({"tokens": _object_col(A)}))[0].column("vec")
+        assert _sparse_rows(out_mat) == _sparse_rows(out_obj)
+
+
+class TestHashingTFParity:
+    @pytest.mark.parametrize("binary", [False, True])
+    def test_transform(self, binary):
+        from flink_ml_tpu.models.feature.hashingtf import HashingTF
+
+        A = _rand_matrix(seed=1)
+        tf = (
+            HashingTF()
+            .set_input_col("tokens")
+            .set_output_col("vec")
+            .set_binary(binary)
+            .set_num_features(64)  # small: force collisions
+        )
+        out_mat = tf.transform(Table({"tokens": A}))[0].column("vec")
+        out_obj = tf.transform(Table({"tokens": _object_col(A)}))[0].column("vec")
+        assert _sparse_rows(out_mat) == _sparse_rows(out_obj)
+
+
+class TestNGramParity:
+    @pytest.mark.parametrize("n", [1, 2, 3, 9])  # 9 > k: empty outputs
+    def test_transform(self, n):
+        from flink_ml_tpu.models.feature.ngram import NGram
+
+        A = _rand_matrix(seed=2)
+        ng = NGram().set_input_col("tokens").set_output_col("grams").set_n(n)
+        out_mat = ng.transform(Table({"tokens": A}))[0].column("grams")
+        out_obj = ng.transform(Table({"tokens": _object_col(A)}))[0].column("grams")
+        mat_lists = (
+            [list(r) for r in out_mat]
+            if isinstance(out_mat, np.ndarray) and out_mat.ndim == 2
+            else [list(r) for r in out_mat]
+        )
+        assert mat_lists == [list(r) for r in out_obj]
+
+
+class TestStopWordsRemoverParity:
+    @pytest.mark.parametrize("case_sensitive", [False, True])
+    def test_transform(self, case_sensitive):
+        from flink_ml_tpu.models.feature.stopwordsremover import StopWordsRemover
+
+        A = _rand_matrix(seed=3)
+        sw = (
+            StopWordsRemover()
+            .set_input_cols("tokens")
+            .set_output_cols("kept")
+            .set_stop_words("1", "5", "7")
+            .set_case_sensitive(case_sensitive)
+        )
+        out_mat = sw.transform(Table({"tokens": A}))[0].column("kept")
+        out_obj = sw.transform(Table({"tokens": _object_col(A)}))[0].column("kept")
+        assert [list(r) for r in out_mat] == [list(r) for r in out_obj]
+
+
+class TestTokenizerParity:
+    def test_transform(self):
+        from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+        strings = np.asarray(
+            ["A b  c", "a B", "", "x\ty z ", "a B"], dtype="<U8"
+        )
+        obj = np.empty(len(strings), dtype=object)
+        obj[:] = [str(s) for s in strings]
+        tk = Tokenizer().set_input_col("s").set_output_col("t")
+        out_mat = tk.transform(Table({"s": strings}))[0].column("t")
+        out_obj = tk.transform(Table({"s": obj}))[0].column("t")
+        assert [list(r) for r in out_mat] == [list(r) for r in out_obj]
+
+
+class TestRegexTokenizerParity:
+    @pytest.mark.parametrize("gaps", [True, False])
+    def test_transform(self, gaps):
+        from flink_ml_tpu.models.feature.regextokenizer import RegexTokenizer
+
+        strings = np.asarray(["Aa1 bb2", "c33 D", "e", "c33 D"], dtype="<U8")
+        obj = np.empty(len(strings), dtype=object)
+        obj[:] = [str(s) for s in strings]
+        rt = (
+            RegexTokenizer()
+            .set_input_col("s")
+            .set_output_col("t")
+            .set_gaps(gaps)
+            .set_pattern(r"\s+" if gaps else r"[a-z]+")
+        )
+        out_mat = rt.transform(Table({"s": strings}))[0].column("t")
+        out_obj = rt.transform(Table({"s": obj}))[0].column("t")
+        assert [list(r) for r in out_mat] == [list(r) for r in out_obj]
+
+
+class TestStringIndexerParity:
+    @pytest.mark.parametrize(
+        "order", ["arbitrary", "alphabetAsc", "alphabetDesc", "frequencyDesc", "frequencyAsc"]
+    )
+    def test_fit_transform(self, order):
+        from flink_ml_tpu.models.feature.stringindexer import StringIndexer
+
+        rng = np.random.RandomState(4)
+        vocab = np.array(["aa", "b", "cc", "d", "e"])
+        S = vocab[rng.randint(0, 5, size=200)]
+        obj = np.empty(len(S), dtype=object)
+        obj[:] = [str(s) for s in S]
+        si = (
+            StringIndexer()
+            .set_input_cols("s")
+            .set_output_cols("idx")
+            .set_string_order_type(order)
+        )
+        m_mat = si.fit(Table({"s": S}))
+        m_obj = si.fit(Table({"s": obj}))
+        if order.startswith("frequency"):
+            # tie order may differ between Counter and np.unique; compare the
+            # (string -> frequency-rank-class) assignment instead
+            assert sorted(m_mat.string_arrays[0]) == sorted(m_obj.string_arrays[0])
+        else:
+            assert m_mat.string_arrays == m_obj.string_arrays
+        out_mat = np.asarray(m_mat.transform(Table({"s": S}))[0].column("idx"))
+        out_ref = np.asarray(m_mat.transform(Table({"s": obj}))[0].column("idx"))
+        np.testing.assert_array_equal(out_mat, out_ref)
+
+    def test_unseen_raises(self):
+        from flink_ml_tpu.models.feature.stringindexer import StringIndexer
+
+        si = StringIndexer().set_input_cols("s").set_output_cols("idx")
+        model = si.fit(Table({"s": np.asarray(["a", "b"], dtype="<U2")}))
+        with pytest.raises(ValueError, match="unseen string"):
+            model.transform(Table({"s": np.asarray(["a", "zz"], dtype="<U2")}))
+
+    def test_skip_invalid_drops_rows(self):
+        from flink_ml_tpu.models.feature.stringindexer import StringIndexer
+
+        si = (
+            StringIndexer()
+            .set_input_cols("s")
+            .set_output_cols("idx")
+            .set_handle_invalid("skip")
+        )
+        model = si.fit(Table({"s": np.asarray(["a", "b"], dtype="<U2")}))
+        out = model.transform(Table({"s": np.asarray(["a", "zz", "b"], dtype="<U2")}))[0]
+        assert out.num_rows == 2
+
+
+def _dict_col(matrix):
+    """Dictionary-encode an object/unicode token matrix for the device path."""
+    from flink_ml_tpu.models.feature import _tokens
+    from flink_ml_tpu.table import DictTokenMatrix
+
+    uniq, ids = _tokens.encode(matrix)
+    return DictTokenMatrix(uniq, ids)
+
+
+class TestDictTokenMatrixParity:
+    """The dictionary-encoded (device) paths must agree with the per-row
+    object-array paths for every string stage that has one."""
+
+    @pytest.mark.parametrize("binary", [False, True])
+    @pytest.mark.parametrize("min_tf", [1.0, 2.0, 0.2])
+    def test_countvectorizer(self, binary, min_tf):
+        from flink_ml_tpu.models.feature.countvectorizer import CountVectorizer
+
+        A = _rand_matrix(seed=7)
+        cv = (
+            CountVectorizer()
+            .set_input_col("tokens")
+            .set_output_col("vec")
+            .set_binary(binary)
+            .set_min_tf(min_tf)
+            .set_min_df(2.0)
+        )
+        m_obj = cv.fit(Table({"tokens": _object_col(A)}))
+        m_dict = cv.fit(Table({"tokens": _dict_col(A)}))
+        assert m_dict.vocabulary == m_obj.vocabulary
+        out_obj = m_obj.transform(Table({"tokens": _object_col(A)}))[0].column("vec")
+        out_dict = m_dict.transform(Table({"tokens": _dict_col(A)}))[0].column("vec")
+        obj_rows = _sparse_rows(out_obj)
+        dict_rows = [
+            (
+                [int(i) for i in np.asarray(out_dict.indices[r]) if i >= 0],
+                [
+                    float(v)
+                    for i, v in zip(
+                        np.asarray(out_dict.indices[r]), np.asarray(out_dict.values[r])
+                    )
+                    if i >= 0
+                ],
+            )
+            for r in range(out_dict.n)
+        ]
+        assert dict_rows == obj_rows
+
+    def test_hashingtf(self):
+        from flink_ml_tpu.models.feature.hashingtf import HashingTF
+
+        A = _rand_matrix(seed=8)
+        tf = (
+            HashingTF().set_input_col("tokens").set_output_col("vec").set_num_features(64)
+        )
+        out_obj = tf.transform(Table({"tokens": _object_col(A)}))[0].column("vec")
+        out_dict = tf.transform(Table({"tokens": _dict_col(A)}))[0].column("vec")
+        obj_rows = _sparse_rows(out_obj)
+        for r in range(out_dict.n):
+            idx = np.asarray(out_dict.indices[r])
+            val = np.asarray(out_dict.values[r])
+            mask = idx >= 0
+            assert ([int(i) for i in idx[mask]], [float(v) for v in val[mask]]) == obj_rows[r]
+
+    @pytest.mark.parametrize("n", [1, 2, 3])
+    def test_ngram(self, n):
+        from flink_ml_tpu.models.feature.ngram import NGram
+
+        A = _rand_matrix(seed=9, k=5)
+        ng = NGram().set_input_col("tokens").set_output_col("grams").set_n(n)
+        out_obj = ng.transform(Table({"tokens": _object_col(A)}))[0].column("grams")
+        out_dict = ng.transform(Table({"tokens": _dict_col(A)}))[0].column("grams")
+        from flink_ml_tpu.table import DictTokenMatrix
+
+        assert isinstance(out_dict, DictTokenMatrix)
+        assert [out_dict.row(i) for i in range(len(out_dict))] == [
+            list(r) for r in out_obj
+        ]
+
+    @pytest.mark.parametrize("case_sensitive", [False, True])
+    def test_stopwordsremover(self, case_sensitive):
+        from flink_ml_tpu.models.feature.stopwordsremover import StopWordsRemover
+
+        A = _rand_matrix(seed=10)
+        sw = (
+            StopWordsRemover()
+            .set_input_cols("tokens")
+            .set_output_cols("kept")
+            .set_stop_words("1", "5", "7")
+            .set_case_sensitive(case_sensitive)
+        )
+        out_obj = sw.transform(Table({"tokens": _object_col(A)}))[0].column("kept")
+        out_dict = sw.transform(Table({"tokens": _dict_col(A)}))[0].column("kept")
+        assert [out_dict.row(i) for i in range(len(out_dict))] == [
+            list(r) for r in out_obj
+        ]
+
+
+class TestTokenColumnTablePlumbing:
+    """Table.rows()/collect()/concat must handle the token column layouts
+    (review findings: DenseVector coercion crash, concat crashes)."""
+
+    def test_collect_unicode_matrix(self):
+        t = Table({"tok": np.asarray([["a", "b"], ["c", "d"]])})
+        assert [r["tok"] for r in t.collect()] == [["a", "b"], ["c", "d"]]
+
+    def test_collect_dict_tokens(self):
+        A = _rand_matrix(n=4, k=3)
+        t = Table({"tok": _dict_col(A)})
+        assert [r["tok"] for r in t.collect()] == [list(r) for r in A]
+
+    def test_concat_dict_tokens_different_vocabs(self):
+        a = _dict_col(np.asarray([["a", "b"], ["b", "a"]]))
+        b = _dict_col(np.asarray([["c", "a", "c"], ["a", "c", "b"]]))
+        merged = Table({"tok": a}).concat(Table({"tok": b}))
+        assert [r["tok"] for r in merged.collect()] == [
+            ["a", "b"],
+            ["b", "a"],
+            ["c", "a", "c"],
+            ["a", "c", "b"],
+        ]
+
+    def test_concat_unicode_matrices_different_widths(self):
+        a = np.asarray([["a", "b"]])
+        b = np.asarray([["c", "d", "e"]])
+        merged = Table({"tok": a}).concat(Table({"tok": b}))
+        assert [r["tok"] for r in merged.collect()] == [["a", "b"], ["c", "d", "e"]]
+
+    def test_reservoir_sample_token_table(self):
+        from flink_ml_tpu.utils.datastream import sample
+
+        tables = [
+            Table({"tok": _dict_col(_rand_matrix(n=20, k=3, seed=s))})
+            for s in range(3)
+        ]
+        out = sample(tables, 10, seed=0)
+        assert out.num_rows == 10
