@@ -11,12 +11,16 @@ the repo root for the reference structural analysis this build follows.
 
 from .api import AlgoOperator, Estimator, Model, Stage, Transformer
 from .pipeline import Pipeline, PipelineModel
-from .table import SparseBatch, StreamTable, Table
+from .functions import array_to_vector, vector_to_array
+from .table import DictTokenMatrix, SparseBatch, StreamTable, Table
 from .linalg import DenseMatrix, DenseVector, SparseVector, Vectors
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "array_to_vector",
+    "vector_to_array",
+    "DictTokenMatrix",
     "AlgoOperator",
     "Estimator",
     "Model",

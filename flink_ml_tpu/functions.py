@@ -1,0 +1,77 @@
+"""Column conversion functions between vector and array layouts.
+
+TPU-native re-design of the reference's Table-API scalar UDFs
+`Functions.vectorToArray` / `Functions.arrayToVector`
+(flink-ml-lib/src/main/java/org/apache/flink/ml/Functions.java:10-38,
+VectorToArrayFunction / ArrayToVectorFunction). The reference converts one
+row at a time inside a SQL expression; here the conversion is columnar:
+the canonical dense layout for both vectors and arrays is an (n, d)
+numeric matrix (host or device), so uniform-width conversions are
+zero-copy passthroughs and only ragged/object columns materialize per-row
+objects.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .linalg import DenseVector, Vector
+from .table import SparseBatch, _is_jax_array
+
+__all__ = ["vector_to_array", "array_to_vector"]
+
+
+def vector_to_array(col):
+    """Vector column -> array column (VectorToArrayFunction.eval).
+
+    Dense (n, d) batches (numpy or device) pass through unchanged —
+    they already ARE the columnar array layout. SparseBatch densifies;
+    object columns of Vector values become per-row float lists (ragged
+    widths stay ragged).
+    """
+    if isinstance(col, SparseBatch):
+        return col.to_dense()
+    if _is_jax_array(col) and col.ndim == 2:
+        return col
+    arr = col
+    if isinstance(arr, np.ndarray) and arr.dtype != object:
+        if arr.ndim == 2:
+            return arr
+        raise ValueError("vector_to_array expects an (n, d) vector column")
+    out_rows = []
+    for v in arr:
+        if isinstance(v, Vector):
+            out_rows.append(np.asarray(v.to_array(), dtype=np.float64))
+        else:
+            out_rows.append(np.asarray(v, dtype=np.float64))
+    widths = {r.shape[0] for r in out_rows}
+    if len(widths) == 1:
+        return np.stack(out_rows)
+    out = np.empty(len(out_rows), dtype=object)
+    for i, r in enumerate(out_rows):
+        out[i] = r.tolist()
+    return out
+
+
+def array_to_vector(col):
+    """Array column -> DenseVector column (ArrayToVectorFunction.eval).
+
+    Uniform-width numeric input (lists, (n, d) arrays, device arrays)
+    becomes/stays the canonical (n, d) dense batch; ragged object input
+    becomes an object column of DenseVector values.
+    """
+    if _is_jax_array(col) and col.ndim == 2:
+        return col
+    arr = col
+    if isinstance(arr, np.ndarray) and arr.dtype != object:
+        if arr.ndim == 2:
+            return arr.astype(np.float64, copy=False)
+        raise ValueError("array_to_vector expects an (n, d) array column")
+    rows = [np.asarray(v, dtype=np.float64) for v in arr]
+    widths = {r.shape[0] for r in rows}
+    if len(widths) == 1:
+        return np.stack(rows)
+    out = np.empty(len(rows), dtype=object)
+    for i, r in enumerate(rows):
+        out[i] = DenseVector(r)
+    return out

@@ -128,12 +128,17 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
         merges = []  # (id1, id2, distance, merged size)
         merge_members = []  # row sets merged at each step, for labeling
         next_merge_stopped = None  # merge count at which the stop criterion hit
+        # cached per-row nearest neighbours: the global closest pair is then
+        # an O(n) scan instead of an O(n^2) full-matrix argmin per merge —
+        # the difference between O(n^3) and ~O(n^2) total (the r3 benchmark
+        # ran this loop at 90.6 records/s)
+        row_min = dist.min(axis=1)
+        row_arg = dist.argmin(axis=1)
+        row_ids = np.arange(n)
         while num_active > 1:
-            # global closest pair; merged rows/cols are masked to +inf so no
-            # per-iteration submatrix copies are needed
-            flat = np.argmin(dist)
-            i, j = np.unravel_index(flat, dist.shape)
-            d_ij = dist[i, j]
+            i = int(np.argmin(row_min))
+            j = int(row_arg[i])
+            d_ij = row_min[i]
             stop_hit = (
                 threshold is not None and d_ij > threshold
             ) or (threshold is None and num_active <= num_clusters)
@@ -155,6 +160,23 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
             dist[i, i] = np.inf
             dist[j, :] = np.inf
             dist[:, j] = np.inf
+            # nearest-neighbour cache maintenance: j dies; i recomputes; a
+            # row whose distance to the merged cluster improved points at i;
+            # a row whose cached nearest was i or j (and didn't improve) is
+            # stale and rescans
+            row_min[j], row_arg[j] = np.inf, j
+            row_min[i], row_arg[i] = dist[i].min(), int(dist[i].argmin())
+            nr = np.where(finite, new_row, np.inf)
+            better = nr < row_min
+            better[i] = False
+            row_min[better] = nr[better]
+            row_arg[better] = i
+            stale = np.flatnonzero(
+                ((row_arg == i) | (row_arg == j)) & ~better & (row_ids != i) & finite
+            )
+            for k in stale:
+                row_min[k] = dist[k].min()
+                row_arg[k] = int(dist[k].argmin())
             sizes[i] += sizes[j]
             cluster_ids[i] = n + len(merges) - 1
             members[i].extend(members.pop(j))

@@ -16,6 +16,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ...api import AlgoOperator
 from ...common.param import HasLabelCol, HasRawPredictionCol, HasWeightCol
@@ -108,26 +109,116 @@ def _binary_metrics(scores: np.ndarray, labels: np.ndarray, weights: np.ndarray)
     }
 
 
+@jax.jit
+def _binary_metrics_device(scores, labels, weights):
+    """The same four metrics as `_binary_metrics` in ONE jitted device pass,
+    returned packed as [auc, aupr, lorenz, ks] (single readback).
+
+    The numpy oracle compacts per-threshold points with boolean indexing
+    (`tpr[is_last]`) — a dynamic shape XLA can't trace. Here every row
+    carries its group's values and non-last rows contribute zero: the
+    previous threshold point for row p is the last row of the previous
+    group, found by gathering at (start_of_group - 1). Scoring 10M rows is
+    then a device sort + cumsums instead of a host argsort
+    (BinaryClassificationEvaluator.java:99-198 distributes across score
+    ranges for the same reason)."""
+    n = scores.shape[0]
+    f = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    order = jnp.argsort(-scores, stable=True)
+    s = scores[order].astype(f)
+    y = labels[order].astype(f)
+    w = weights[order].astype(f)
+    pos = w * (y == 1.0)
+    neg = w * (y != 1.0)
+    total_pos = pos.sum()
+    total_neg = neg.sum()
+    total = total_pos + total_neg
+    cum_pos = jnp.cumsum(pos)
+    cum_neg = jnp.cumsum(neg)
+    cum_all = cum_pos + cum_neg
+
+    tpr = jnp.where(total_pos > 0, cum_pos / total_pos, 1.0)
+    fpr = jnp.where(total_neg > 0, cum_neg / total_neg, 1.0)
+    rate = cum_all / total
+    prec = jnp.where(cum_all > 0, cum_pos / cum_all, 1.0)
+
+    idx = jnp.arange(n)
+    is_last = jnp.concatenate([s[:-1] != s[1:], jnp.ones((1,), bool)])
+    is_first = jnp.concatenate([jnp.ones((1,), bool), s[:-1] != s[1:]])
+    sog = lax.cummax(jnp.where(is_first, idx, 0))  # start-of-group index
+    prev = jnp.maximum(sog - 1, 0)  # last row of the previous group
+    first_group = sog == 0
+    tpr_prev = jnp.where(first_group, 0.0, tpr[prev])
+    fpr_prev = jnp.where(first_group, 0.0, fpr[prev])
+    rate_prev = jnp.where(first_group, 0.0, rate[prev])
+    prec_prev = jnp.where(first_group, 1.0, prec[prev])
+
+    lastf = is_last.astype(f)
+    aupr = jnp.sum(lastf * (tpr - tpr_prev) * (prec + prec_prev) * 0.5)
+    lorenz = jnp.sum(lastf * (rate - rate_prev) * (tpr + tpr_prev) * 0.5)
+    ks = jnp.max(lastf * jnp.abs(tpr - fpr))
+
+    # weighted rank-sum AUC: per tied-score group, average integer rank
+    # (ranks ascend from the lowest score) times the group positive weight.
+    # Ranks in a group are consecutive integers, so the average is the
+    # exact arithmetic-series midpoint — no rank cumsum, whose float32
+    # error at 10M rows (cumulative values ~5e13) would swamp the result
+    avg_rank = ((n - sog).astype(f) + (n - idx).astype(f)) * 0.5
+    cum_pos_prev = jnp.where(first_group, 0.0, cum_pos[prev])
+    group_pos_w = cum_pos - cum_pos_prev
+    rank_sum = jnp.sum(lastf * avg_rank * group_pos_w)
+    auc = jnp.where(
+        (total_pos > 0) & (total_neg > 0),
+        (rank_sum - total_pos * (total_pos + 1) / 2.0)
+        / jnp.maximum(total_pos * total_neg, 1e-30),
+        jnp.nan,
+    )
+    return jnp.stack([auc, aupr, lorenz, ks])
+
+
 class BinaryClassificationEvaluator(AlgoOperator, BinaryClassificationEvaluatorParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        labels = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        labels_col = table.column(self.get_label_col())
         raw = table.column(self.get_raw_prediction_col())
-        raw_arr = np.asarray(
-            raw if not hasattr(raw, "to_dense") else raw.to_dense(), dtype=np.float64
-        )
-        if raw_arr.ndim == 2:
-            scores = raw_arr[:, 1]  # probability of class 1
-        elif raw_arr.dtype == object:
-            scores = np.asarray([v.get(1) for v in raw_arr], dtype=np.float64)
+        if isinstance(raw, jax.Array) and raw.ndim == 2:
+            if raw.shape[1] < 2:  # jax indexing would silently clamp
+                raise IndexError(
+                    f"rawPrediction needs >= 2 columns, got {raw.shape[1]}"
+                )
+            scores = raw[:, 1]  # device predictions stay on device
         else:
-            scores = raw_arr
+            raw_arr = np.asarray(
+                raw if not hasattr(raw, "to_dense") else raw.to_dense(),
+                dtype=np.float64,
+            )
+            if raw_arr.ndim == 2:
+                scores = raw_arr[:, 1]  # probability of class 1
+            elif raw_arr.dtype == object:
+                scores = np.asarray([v.get(1) for v in raw_arr], dtype=np.float64)
+            else:
+                scores = raw_arr
         weight_col = self.get_weight_col()
-        weights = (
-            np.ones_like(labels)
-            if weight_col is None
-            else np.asarray(table.column(weight_col), dtype=np.float64)
+        labels = (
+            labels_col
+            if isinstance(labels_col, jax.Array)
+            else np.asarray(labels_col, dtype=np.float64)
         )
-        metrics = _binary_metrics(scores, labels, weights)
+        weights = (
+            jnp.ones(np.shape(labels)[0], jnp.float32)
+            if weight_col is None
+            else table.column(weight_col)
+        )
+        packed = np.asarray(
+            _binary_metrics_device(
+                jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)
+            )
+        )
+        metrics = {
+            AREA_UNDER_ROC: float(packed[0]),
+            AREA_UNDER_PR: float(packed[1]),
+            AREA_UNDER_LORENZ: float(packed[2]),
+            KS: float(packed[3]),
+        }
         names = self.get_metrics_names()
         return [Table({name: [metrics[name]] for name in names})]

@@ -14,7 +14,7 @@ each stage.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -130,11 +130,3 @@ def lookup(uniq: np.ndarray, mapping, default: int = -1) -> np.ndarray:
         if v is not None:
             out[j] = v
     return out
-
-
-def token_lists(col) -> List[list]:
-    """Per-row token lists from either column layout (tests/collect path)."""
-    A = token_matrix(col)
-    if A is not None:
-        return [row.tolist() for row in A]
-    return [list(tokens) for tokens in col]

@@ -23,6 +23,7 @@ from ...param import ParamValidators, StringParam
 from ...table import Table
 from ...utils import read_write
 from ...utils.param_utils import update_existing_params
+from . import _tokens
 
 ARBITRARY_ORDER = "arbitrary"
 FREQUENCY_DESC_ORDER = "frequencyDesc"
@@ -122,7 +123,7 @@ class StringIndexerModel(Model, StringIndexerModelParams):
             mapping = {s: float(i) for i, s in enumerate(strings)}
             unseen = float(len(strings))
             col = table.column(name)
-            if isinstance(col, np.ndarray) and col.ndim == 1 and col.dtype.kind in "US":
+            if _tokens.string_column(col) is not None:
                 # columnar string path: look each DISTINCT value up once
                 uniq, inv = np.unique(col, return_inverse=True)
                 uniq_out = np.empty(len(uniq), dtype=np.float64)
@@ -236,7 +237,7 @@ class StringIndexer(Estimator, StringIndexerParams):
         string_arrays: List[List[str]] = []
         for name in self.get_input_cols():
             col = table.column(name)
-            if isinstance(col, np.ndarray) and col.ndim == 1 and col.dtype.kind in "US":
+            if _tokens.string_column(col) is not None:
                 # columnar string path: one np.unique instead of a host loop
                 uniq, cnt = np.unique(col, return_counts=True)
                 counts = Counter(dict(zip((str(u) for u in uniq), cnt)))

@@ -173,13 +173,14 @@ def ngram_codes(ids, num_terms, gram):
     give an empty array)."""
     n, k = ids.shape
     out_k = k - gram + 1
-    code = jnp.zeros((n, out_k), jnp.int64)
+    # int32 is exact here: callers guard num_terms**gram <= 4e6 << 2^31
+    code = jnp.zeros((n, out_k), jnp.int32)
     valid = jnp.ones((n, out_k), jnp.bool_)
     for t in range(gram):
         part = ids[:, t : t + out_k]
         valid &= part >= 0
         code = code * num_terms + jnp.where(part >= 0, part, 0)
-    return jnp.where(valid, code, -1).astype(jnp.int64)
+    return jnp.where(valid, code, -1)
 
 
 def ngram_vocab(vocab: np.ndarray, gram: int) -> np.ndarray:

@@ -183,20 +183,31 @@ def _sparse_vectors_to_batch(vectors: Sequence[SparseVector]) -> SparseBatch:
     return SparseBatch(size, indices, values)
 
 
-def _token_matrix_to_object(A: np.ndarray) -> np.ndarray:
-    out = np.empty(A.shape[0], dtype=object)
-    for i in range(A.shape[0]):
-        out[i] = [str(t) for t in A[i]]
-    return out
+def _is_unicode_matrix(col) -> bool:
+    return isinstance(col, np.ndarray) and col.ndim == 2 and col.dtype.kind in "US"
+
+
+def _is_token_col(col) -> bool:
+    return isinstance(col, DictTokenMatrix) or _is_unicode_matrix(col)
 
 
 def _as_dict_tokens(col) -> "DictTokenMatrix":
     if isinstance(col, DictTokenMatrix):
         return col
-    A = np.asarray(col)
+    A = col if isinstance(col, np.ndarray) else np.asarray(col)
     if A.ndim == 2 and A.dtype.kind in "US":
         uniq, inv = np.unique(A, return_inverse=True)
         return DictTokenMatrix(uniq, inv.reshape(A.shape).astype(np.int32))
+    if A.ndim == 1 and A.dtype == object:
+        # ragged object rows (lists of tokens): encode with -1 padding
+        rows = [[str(t) for t in r] for r in A]
+        vocab = np.unique(np.asarray(sorted({t for r in rows for t in r}) or [""]))
+        index = {t: i for i, t in enumerate(vocab)}
+        k = max((len(r) for r in rows), default=1) or 1
+        ids = np.full((len(rows), k), -1, np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = [index[t] for t in r]
+        return DictTokenMatrix(vocab, ids)
     raise ValueError(
         f"Cannot concatenate token column with incompatible column {type(col).__name__}"
     )
@@ -313,18 +324,14 @@ class Table:
         out = {}
         for name in self.column_names:
             a, b = self._columns[name], other.column(name)
-            if isinstance(a, DictTokenMatrix) or isinstance(b, DictTokenMatrix):
-                out[name] = _concat_token_columns(a, b)
-            elif (
-                isinstance(a, np.ndarray)
-                and a.ndim == 2
-                and a.dtype.kind in "US"
-                and a.shape[1] != np.shape(b)[1]
+            if (_is_token_col(a) or _is_token_col(b)) and not (
+                _is_unicode_matrix(a)
+                and _is_unicode_matrix(b)
+                and a.shape[1] == b.shape[1]
             ):
-                # token matrices of different widths: fall back to ragged
-                out[name] = np.concatenate(
-                    [_token_matrix_to_object(a), _token_matrix_to_object(np.asarray(b))]
-                )
+                # any token layout mix (dict/unicode/object, ragged widths)
+                # concatenates through the dictionary encoding
+                out[name] = _concat_token_columns(a, b)
             elif isinstance(a, SparseBatch):
                 if a.size != b.size:
                     raise ValueError("SparseBatch size mismatch in concat")

@@ -72,7 +72,15 @@ def main(argv) -> None:
     timeout = float(argv[argv.index("--timeout") + 1]) if "--timeout" in argv else 600.0
     out_path = argv[argv.index("--out") + 1] if "--out" in argv else DEFAULT_OUT
     runs = int(argv[argv.index("--runs") + 1]) if "--runs" in argv else 2
-    only = [a for a in argv if a.endswith(".json") and os.path.exists(a)]
+    flag_values = set()
+    for flag in ("--out", "--timeout", "--runs", "--one"):
+        if flag in argv:
+            flag_values.add(argv.index(flag) + 1)
+    only = [
+        a
+        for i, a in enumerate(argv)
+        if i not in flag_values and a.endswith(".json") and os.path.exists(a)
+    ]
     paths = only or sorted(glob.glob(os.path.join(REPO, "conf", "*.json")))
     results = {}
     for path in paths:

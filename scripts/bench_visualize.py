@@ -1,0 +1,124 @@
+"""Visualize / tabulate benchmark results.
+
+Analogue of the reference's benchmark-results-visualize.py
+(flink-ml-dist/src/main/flink-ml-bin/bin/benchmark-results-visualize.py):
+same CLI surface (file, --pattern, --x-field, --y-field with dotted
+nested-field paths, matplotlib scatter), extended with a --table mode
+that renders a throughput-ranked markdown table (the form the sweep
+results are reviewed in — this host is often headless).
+
+Accepts either the runner's `--output-file` JSON ({name: {..., results}})
+or scripts/bench_sweep.py's benchmarks/SWEEP.json ({meta, entries}).
+
+Usage:
+  python scripts/bench_visualize.py benchmarks/SWEEP.json --table
+  python scripts/bench_visualize.py results.json --pattern 'kmeans.*' \
+      --x-field inputData.paramMap.numValues --y-field results.inputThroughput
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+
+def get_nested_field_value(nested, field_names):
+    for field_name in field_names:
+        if not isinstance(nested, dict) or field_name not in nested:
+            return None
+        nested = nested[field_name]
+    return nested
+
+
+def load_rows(file_name: str):
+    """-> list of (name, record) with sweep/runner formats unified."""
+    with open(file_name) as f:
+        data = json.load(f)
+    if "entries" in data and "meta" in data:  # bench_sweep.py format
+        rows = []
+        for key, rec in data["entries"].items():
+            row = dict(rec.get("result") or {})
+            if "error" in rec:
+                row["error"] = rec["error"]
+            rows.append((key, {"results": row, **row}))
+        return rows
+    return [(k, v) for k, v in data.items() if k != "version"]
+
+
+def print_table(rows) -> None:
+    def thr(rec):
+        v = get_nested_field_value(rec, ["results", "inputThroughput"])
+        return v if isinstance(v, (int, float)) else -1.0
+
+    rows = sorted(rows, key=lambda kv: -thr(kv[1]))
+    print(f"| {'benchmark':58s} | {'totalTimeMs':>12s} | {'rec/s':>14s} | phases |")
+    print(f"|{'-' * 60}|{'-' * 14}|{'-' * 16}|--------|")
+    for name, rec in rows:
+        r = rec.get("results", rec)
+        if "error" in r and "totalTimeMs" not in r:
+            print(f"| {name:58s} | {'ERROR':>12s} | {'-':>14s} | {r['error'][:60]} |")
+            continue
+        phases = r.get("phaseTimesMs", {})
+        phase_str = " ".join(f"{k}:{v:.0f}" for k, v in phases.items())
+        print(
+            f"| {name:58s} | {r.get('totalTimeMs', 0):12.1f} |"
+            f" {r.get('inputThroughput', 0):14.1f} | {phase_str} |"
+        )
+
+
+def main(argv) -> None:
+    parser = argparse.ArgumentParser(description="Visualizes benchmark results.")
+    parser.add_argument("file_name", help="Json file to acquire benchmark results.")
+    parser.add_argument(
+        "--pattern",
+        default=".*",
+        help="Regex of benchmark names to select (default: all).",
+    )
+    parser.add_argument(
+        "--x-field", default="inputData.paramMap.numValues", help="Independent field."
+    )
+    parser.add_argument(
+        "--y-field", default="results.inputThroughput", help="Dependent field."
+    )
+    parser.add_argument(
+        "--table",
+        action="store_true",
+        help="Print a throughput-ranked markdown table instead of plotting.",
+    )
+    parser.add_argument(
+        "--save", default=None, help="Save the plot to a file instead of showing it."
+    )
+    args = parser.parse_args(argv)
+    pattern = re.compile(args.pattern)
+    rows = [(k, v) for k, v in load_rows(args.file_name) if pattern.match(k)]
+    if args.table:
+        print_table(rows)
+        return
+    import matplotlib
+
+    if args.save:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs, ys = [], []
+    for _, rec in rows:
+        x = get_nested_field_value(rec, args.x_field.split("."))
+        y = get_nested_field_value(rec, args.y_field.split("."))
+        if x is not None and y is not None:
+            xs.append(x)
+            ys.append(y)
+    plt.scatter(xs, ys)
+    plt.xlabel(args.x_field)
+    plt.ylabel(args.y_field)
+    plt.title("Benchmark Results Visualization")
+    if args.save:
+        plt.savefig(args.save, dpi=120, bbox_inches="tight")
+        print(f"saved {args.save}")
+    else:
+        plt.show()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -3,6 +3,7 @@ taken from the reference tests (ANOVATestTest.java EXPECTED_OUTPUT_DENSE,
 BinaryClassificationEvaluatorTest.java EXPECTED_DATA/_M/_W,
 FValueTestTest.java / ChiSqTestTest.java shapes)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -175,3 +176,71 @@ class TestBinaryClassificationEvaluator:
     def test_invalid_metric_rejected(self):
         with pytest.raises(ValueError):
             BinaryClassificationEvaluator().set_metrics_names("nope")
+
+
+class TestDeviceEvaluatorParity:
+    """The device metric pass must match the numpy oracle (_binary_metrics)
+    across weights, heavy score ties, and degenerate label distributions."""
+
+    @pytest.mark.parametrize("weighted", [False, True])
+    @pytest.mark.parametrize("tie_levels", [None, 7, 2])
+    def test_matches_numpy_oracle(self, weighted, tie_levels):
+        from flink_ml_tpu.models.evaluation.binaryclassification import (
+            _binary_metrics,
+            _binary_metrics_device,
+        )
+
+        rng = np.random.default_rng(5)
+        n = 4000
+        scores = rng.random(n)
+        if tie_levels is not None:  # quantize to force tied groups
+            scores = np.round(scores * tie_levels) / tie_levels
+        labels = (rng.random(n) < scores).astype(np.float64)
+        weights = rng.random(n) + 0.1 if weighted else np.ones(n)
+        oracle = _binary_metrics(scores, labels, weights)
+        packed = np.asarray(
+            _binary_metrics_device(
+                jnp.asarray(scores, jnp.float32),
+                jnp.asarray(labels, jnp.float32),
+                jnp.asarray(weights, jnp.float32),
+            )
+        )
+        got = dict(zip(["areaUnderROC", "areaUnderPR", "areaUnderLorenz", "ks"], packed))
+        for name, expect in oracle.items():
+            assert abs(got[name] - expect) < 2e-4, (name, got[name], expect)
+
+    def test_single_class_nan_auc(self):
+        from flink_ml_tpu.models.evaluation.binaryclassification import (
+            _binary_metrics_device,
+        )
+
+        packed = np.asarray(
+            _binary_metrics_device(
+                jnp.asarray([0.3, 0.7, 0.5], jnp.float32),
+                jnp.asarray([1.0, 1.0, 1.0], jnp.float32),
+                jnp.asarray([1.0, 1.0, 1.0], jnp.float32),
+            )
+        )
+        assert np.isnan(packed[0])
+
+    def test_device_scores_stay_on_device(self):
+        """LR's device transform output feeds the evaluator without a host
+        round trip of the raw predictions."""
+        import jax
+
+        from flink_ml_tpu.models.evaluation.binaryclassification import (
+            BinaryClassificationEvaluator,
+        )
+        from flink_ml_tpu.table import Table
+
+        n = 512
+        rng = np.random.default_rng(0)
+        raw = jnp.asarray(np.stack([1 - rng.random(n), rng.random(n)], axis=1))
+        labels = jnp.asarray((rng.random(n) > 0.5).astype(np.float32))
+        out = (
+            BinaryClassificationEvaluator()
+            .set_metrics_names("areaUnderROC", "ks")
+            .transform(Table({"label": labels, "rawPrediction": raw}))
+        )[0]
+        row = out.collect()[0]
+        assert 0.0 <= row["areaUnderROC"] <= 1.0 and 0.0 <= row["ks"] <= 1.0

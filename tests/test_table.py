@@ -78,3 +78,46 @@ def test_stream_table():
     assert [b.column("a")[0] for b in st] == [1.0, 2.0]
     # re-iterable when built from a list
     assert [b.column("a")[0] for b in st] == [1.0, 2.0]
+
+
+class TestFunctions:
+    """vector_to_array / array_to_vector (Functions.java:10-38 parity)."""
+
+    def test_vector_to_array_roundtrip(self):
+        from flink_ml_tpu import array_to_vector, vector_to_array
+        from flink_ml_tpu.linalg import Vectors
+
+        vecs = np.empty(2, dtype=object)
+        vecs[0] = Vectors.dense([1.0, 2.0])
+        vecs[1] = Vectors.sparse(2, [1], [3.0])
+        arrs = vector_to_array(vecs)
+        np.testing.assert_array_equal(arrs, [[1.0, 2.0], [0.0, 3.0]])
+        back = array_to_vector(arrs)
+        np.testing.assert_array_equal(back, arrs)  # canonical dense batch
+
+    def test_sparse_batch_densifies(self):
+        from flink_ml_tpu import SparseBatch, vector_to_array
+
+        sb = SparseBatch(3, [[0, 2], [1, -1]], [[1.0, 2.0], [5.0, 0.0]])
+        np.testing.assert_array_equal(
+            vector_to_array(sb), [[1.0, 0.0, 2.0], [0.0, 5.0, 0.0]]
+        )
+
+    def test_ragged_arrays_become_dense_vectors(self):
+        from flink_ml_tpu import array_to_vector
+        from flink_ml_tpu.linalg import DenseVector
+
+        col = np.empty(2, dtype=object)
+        col[0] = [1.0, 2.0]
+        col[1] = [3.0]
+        out = array_to_vector(col)
+        assert isinstance(out[0], DenseVector) and out[1].size() == 1
+
+    def test_device_passthrough(self):
+        import jax.numpy as jnp
+
+        from flink_ml_tpu import array_to_vector, vector_to_array
+
+        X = jnp.ones((4, 3))
+        assert vector_to_array(X) is X
+        assert array_to_vector(X) is X

@@ -335,3 +335,20 @@ class TestTokenColumnTablePlumbing:
         ]
         out = sample(tables, 10, seed=0)
         assert out.num_rows == 10
+
+
+class TestMixedLayoutConcat:
+    def test_matrix_concat_object(self):
+        a = np.asarray([["a", "b"]])
+        obj = np.empty(1, dtype=object)
+        obj[0] = ["c"]
+        merged = Table({"tok": a}).concat(Table({"tok": obj}))
+        assert [r["tok"] for r in merged.collect()] == [["a", "b"], ["c"]]
+
+    def test_object_concat_dict(self):
+        obj = np.empty(2, dtype=object)
+        obj[0] = ["x", "y"]
+        obj[1] = []
+        d = _dict_col(np.asarray([["a", "x"]]))
+        merged = Table({"tok": obj}).concat(Table({"tok": d}))
+        assert [r["tok"] for r in merged.collect()] == [["x", "y"], [], ["a", "x"]]
