@@ -226,6 +226,60 @@ def bench_cpu_baseline(num_rows):
     return {"totalTimeMs": elapsed * 1000.0, "inputThroughput": num_rows / elapsed}
 
 
+def bench_wide_sparse_lr(num_rows=1_000_000, dim=1_000_000, nnz=39):
+    """The Criteo-style wide-model workload (SURVEY §2.3's TP motivation):
+    LR at dim 1e6 over padded-CSR sparse rows (nnz=39 mirrors Criteo's 39
+    features). Densified float32 this would be num_rows*dim*4 = 4TB — the
+    sparse path holds (n, nnz) index/value arrays (~312MB) plus the (d,)
+    model. Data is device-born like the headline workload; the dp x tp
+    feature-sharded layout of the same engine is exercised by
+    tests/test_sparse_training.py::TestShardedSparse and
+    __graft_entry__.dryrun_multichip (one chip here, so no tp split to
+    time)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.losses import SPARSE_BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+
+    key = jax.random.PRNGKey(5)
+    k1, k2, k3 = jax.random.split(key, 3)
+    indices = jax.random.randint(k1, (num_rows, nnz), 0, dim, dtype=jnp.int32)
+    values = jax.random.uniform(k2, (num_rows, nnz), dtype=jnp.float32)
+    y = (jax.random.uniform(k3, (num_rows,)) > 0.5).astype(jnp.float32)
+    sgd = SGD(
+        max_iter=MAX_ITER,
+        learning_rate=LR_RATE,
+        global_batch_size=min(BATCH, num_rows),
+        tol=TOL,
+    )
+    runs = []
+    losses = []
+    for i in range(3):  # run 0 = cold (compile)
+        t0 = time.perf_counter()
+        coeff, loss, epochs = sgd.optimize(
+            np.zeros(dim, np.float32), (indices, values), y, None,
+            SPARSE_BINARY_LOGISTIC_LOSS,
+        )
+        runs.append(time.perf_counter() - t0)
+        losses.append(loss)
+        log(
+            f"wide sparse LR run {i}: fit {runs[-1] * 1000:.0f} ms, loss {loss:.6f}"
+            + (" (cold: includes compile)" if i == 0 else "")
+        )
+    warm = min(runs[1:])
+    return {
+        "coldTimeMs": runs[0] * 1000.0,
+        "totalTimeMs": warm * 1000.0,
+        "inputRecordNum": num_rows,
+        "dim": dim,
+        "nnzPerRow": nnz,
+        "inputThroughput": num_rows / warm,
+        "finalLoss": float(losses[-1]),
+        "densifiedBytesAvoided": float(num_rows) * dim * 4,
+    }
+
+
 def bench_kmeans():
     """The reference README's only published number (10k x dim 10, k=2)."""
     from flink_ml_tpu.models.clustering.kmeans import KMeans
@@ -265,7 +319,13 @@ def main(argv):
         except (IndexError, ValueError):
             log("--logreg-rows needs an integer; using default")
 
-    details = {"logisticregression": None, "lossParity": None, "cpuBaseline": None, "kmeans": None}
+    details = {
+        "logisticregression": None,
+        "lossParity": None,
+        "cpuBaseline": None,
+        "sparseWideLR": None,
+        "kmeans": None,
+    }
     value, vs_baseline, vs_baseline_source = None, None, None
 
     def in_budget(reserve=30.0):
@@ -303,9 +363,26 @@ def main(argv):
 
         if in_budget():
             try:
+                details["sparseWideLR"] = bench_wide_sparse_lr()
+            except Exception as e:
+                log(f"sparseWideLR stage failed: {e!r}")
+
+        if in_budget():
+            try:
                 details["kmeans"] = bench_kmeans()
             except Exception as e:
                 log(f"kmeans stage failed: {e!r}")
+
+        try:  # recorded separately by scripts/bench_sweep.py; attach summary
+            sweep_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "benchmarks", "SWEEP.json"
+            )
+            if os.path.exists(sweep_path):
+                with open(sweep_path) as f:
+                    sweep = json.load(f)
+                details["sweep"] = {"file": "benchmarks/SWEEP.json", "meta": sweep["meta"]}
+        except Exception as e:
+            log(f"sweep summary attach failed: {e!r}")
     finally:
         print(
             json.dumps(

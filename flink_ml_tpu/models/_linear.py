@@ -19,9 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.losses import LossFunc
+from ..ops.losses import SPARSE_VARIANTS, LossFunc
 from ..ops.optimizer import SGD, read_train_result
-from ..table import Table, as_dense_matrix
+from ..table import SparseBatch, Table, as_dense_matrix
 
 
 def extract_train_data(
@@ -29,8 +29,16 @@ def extract_train_data(
     features_col: str,
     label_col: Optional[str],
     weight_col: Optional[str],
+    keep_sparse: bool = False,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
-    X = as_dense_matrix(table.column(features_col), allow_device=True)
+    """With `keep_sparse`, a SparseBatch features column stays sparse and is
+    returned as the (indices, values, dim) triple the SGD engine trains on
+    natively — a wide (Criteo-dim) model would not fit densified."""
+    col = table.column(features_col)
+    if keep_sparse and isinstance(col, SparseBatch):
+        X = (col.indices, col.values, col.size)
+    else:
+        X = as_dense_matrix(col, allow_device=True)
     y = None
     if label_col is not None:
         y = _as_host_or_device_vector(table.column(label_col))
@@ -90,7 +98,8 @@ def run_sgd(
         coeff, loss, epochs, _ = optimizer.optimize_stream(None, chunks, loss_func)
         return coeff, loss, epochs
     X, y, w = extract_train_data(
-        table, params.get_features_col(), params.get_label_col(), weight_col
+        table, params.get_features_col(), params.get_label_col(), weight_col,
+        keep_sparse=True,
     )
     flag = None
     if validate_binomial:
@@ -101,11 +110,39 @@ def run_sgd(
             flag = _labels_ok(y)
         else:
             validate_binomial_labels(y)
-    init_coeff = np.zeros(X.shape[1], dtype=np.float64)
+    if isinstance(X, tuple):  # sparse: train on padded CSR, no densify
+        indices, values, dim = X
+        X = (indices, values)
+        loss_func = SPARSE_VARIANTS[loss_func.name]
+        init_coeff = np.zeros(dim, dtype=np.float64)
+    else:
+        init_coeff = np.zeros(X.shape[1], dtype=np.float64)
     result = optimizer.optimize_async(init_coeff, X, y, w, loss_func)
     flag_val, coeff, criteria, epochs = read_train_result(result, flag=flag)
     _raise_if_invalid(flag_val)
     return coeff, criteria, epochs
+
+
+@jax.jit
+def sparse_raw_scores(indices, values, coeff):
+    """Per-row dot of padded-CSR features with the coefficient — the sparse
+    inference hot loop (LogisticRegressionModel.java:131), sharing the
+    masking convention with the training losses via losses.sparse_dot."""
+    from ..ops.losses import sparse_dot
+
+    dot, _, _ = sparse_dot(indices, values, coeff)
+    return dot
+
+
+def raw_scores(col, coeff):
+    """X @ coeff for any features layout (dense host/device, SparseBatch) —
+    wide sparse batches are never densified."""
+    if isinstance(col, SparseBatch):
+        return sparse_raw_scores(
+            jnp.asarray(col.indices), jnp.asarray(col.values), coeff
+        )
+    X = as_dense_matrix(col, allow_device=True)
+    return jnp.asarray(X, coeff.dtype) @ coeff
 
 
 @jax.jit

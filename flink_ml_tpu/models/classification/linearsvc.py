@@ -64,13 +64,17 @@ class LinearSVCParams(
 
 
 @jax.jit
-def _predict(X, coeff, threshold):
+def _predict_from_dot(dot, threshold):
     """prediction = dot >= threshold ? 1 : 0; rawPrediction = [dot, -dot]
     (LinearSVCModel.predictOneDataPoint:170-173)."""
-    dot = X @ coeff
     pred = jnp.where(dot >= threshold, 1.0, 0.0)
     raw = jnp.stack([dot, -dot], axis=1)
     return pred, raw
+
+
+@jax.jit
+def _predict(X, coeff, threshold):
+    return _predict_from_dot(X @ coeff, threshold)
 
 
 class LinearSVCModel(Model, LinearSVCModelParams):
@@ -90,12 +94,19 @@ class LinearSVCModel(Model, LinearSVCModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
-        pred, raw = _predict(
-            jnp.asarray(X, jnp.float32),
-            jnp.asarray(self.coefficient, jnp.float32),
-            jnp.asarray(self.get_threshold(), jnp.float32),
-        )
+        col = table.column(self.get_features_col())
+        from ...table import SparseBatch
+        from .. import _linear
+
+        if isinstance(col, SparseBatch):  # wide sparse: never densify
+            dot = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
+            pred, raw = _predict_from_dot(dot, jnp.asarray(self.get_threshold(), jnp.float32))
+        else:
+            pred, raw = _predict(
+                jnp.asarray(as_dense_matrix(col), jnp.float32),
+                jnp.asarray(self.coefficient, jnp.float32),
+                jnp.asarray(self.get_threshold(), jnp.float32),
+            )
         return [
             table.with_columns(
                 {

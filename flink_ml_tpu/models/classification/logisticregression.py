@@ -60,14 +60,18 @@ class LogisticRegressionParams(
 
 
 @jax.jit
-def _predict(X, coeff):
+def _predict_from_dot(dot):
     """dot >= 0 -> label 1; rawPrediction = [1-p, p], p = sigmoid(dot)
     (LogisticRegressionModel.predictOneDataPoint:165-168)."""
-    dot = X @ coeff
     prob = 1.0 - 1.0 / (1.0 + jnp.exp(dot))
     pred = jnp.where(dot >= 0, 1.0, 0.0)
     raw = jnp.stack([1.0 - prob, prob], axis=1)
     return pred, raw
+
+
+@jax.jit
+def _predict(X, coeff):
+    return _predict_from_dot(X @ coeff)
 
 
 class LogisticRegressionModel(Model, LogisticRegressionModelParams):
@@ -87,9 +91,17 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        device_in = isinstance(X, jax.Array)
-        pred, raw = _predict(jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32))
+        col = table.column(self.get_features_col())
+        from ...table import SparseBatch
+
+        if isinstance(col, SparseBatch):  # wide sparse: never densify
+            dot = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
+            pred, raw = _predict_from_dot(dot)
+            device_in = True
+        else:
+            X = as_dense_matrix(col, allow_device=True)
+            device_in = isinstance(X, jax.Array)
+            pred, raw = _predict(jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32))
         if device_in:  # device data in -> device predictions out, no D2H
             cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
         else:

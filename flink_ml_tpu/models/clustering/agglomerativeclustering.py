@@ -24,6 +24,7 @@ from ...common.param import (
 )
 from ...ops.distance import DistanceMeasure
 from ...param import BooleanParam, DoubleParam, IntParam, ParamValidators, StringParam
+from ...common.window import CountTumblingWindows, GlobalWindows
 from ...table import Table, as_dense_matrix
 
 LINKAGE_WARD = "ward"
@@ -97,6 +98,88 @@ def _lance_williams_update(d_ik, d_jk, d_ij, size_i, size_j, size_k, linkage):
     )
 
 
+def _cluster_block(X, linkage, measure, num_clusters, threshold, compute_full_tree):
+    """Agglomerate one window of rows; returns (pred, merges) with
+    window-local cluster ids (LocalAgglomerativeClusteringFunction.process)."""
+    import jax.numpy as jnp
+
+    n = X.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int32), []
+    dist = np.asarray(measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64)
+    np.fill_diagonal(dist, np.inf)
+    num_active = n
+    sizes = np.ones(n, dtype=np.int64)
+    # fresh id for every merged cluster (n, n+1, ...) — the reference's
+    # reOrderNnChain convention for the merge log
+    cluster_ids = list(range(n))
+    members = {i: [i] for i in range(n)}
+    merges = []  # (id1, id2, distance, merged size)
+    merge_members = []  # row sets merged at each step, for labeling
+    next_merge_stopped = None  # merge count at which the stop criterion hit
+    # cached per-row nearest neighbours: the global closest pair is then
+    # an O(n) scan instead of an O(n^2) full-matrix argmin per merge —
+    # the difference between O(n^3) and ~O(n^2) total (the r3 benchmark
+    # ran this loop at 90.6 records/s)
+    row_min = dist.min(axis=1) if n > 1 else np.full(n, np.inf)
+    row_arg = dist.argmin(axis=1) if n > 1 else np.zeros(n, np.int64)
+    row_ids = np.arange(n)
+    while num_active > 1:
+        i = int(np.argmin(row_min))
+        j = int(row_arg[i])
+        d_ij = row_min[i]
+        stop_hit = (
+            threshold is not None and d_ij > threshold
+        ) or (threshold is None and num_active <= num_clusters)
+        if stop_hit and next_merge_stopped is None:
+            next_merge_stopped = len(merges)
+            if not compute_full_tree:
+                break
+        # merge j into i (log the pre-merge cluster ids, sorted)
+        id_i, id_j = cluster_ids[i], cluster_ids[j]
+        lo, hi = (id_i, id_j) if id_i < id_j else (id_j, id_i)
+        merges.append((lo, hi, float(d_ij), int(sizes[i] + sizes[j])))
+        # Lance-Williams row update against every other live cluster
+        new_row = _lance_williams_update(
+            dist[i], dist[j], d_ij, sizes[i], sizes[j], sizes, linkage
+        )
+        finite = np.isfinite(dist[i]) & np.isfinite(dist[j])
+        dist[i, finite] = new_row[finite]
+        dist[finite, i] = new_row[finite]
+        dist[i, i] = np.inf
+        dist[j, :] = np.inf
+        dist[:, j] = np.inf
+        # nearest-neighbour cache maintenance: j dies; i recomputes; a
+        # row whose distance to the merged cluster improved points at i;
+        # a row whose cached nearest was i or j (and didn't improve) is
+        # stale and rescans
+        row_min[j], row_arg[j] = np.inf, j
+        row_min[i], row_arg[i] = dist[i].min(), int(dist[i].argmin())
+        nr = np.where(finite, new_row, np.inf)
+        better = nr < row_min
+        better[i] = False
+        row_min[better] = nr[better]
+        row_arg[better] = i
+        stale = np.flatnonzero(
+            ((row_arg == i) | (row_arg == j)) & ~better & (row_ids != i) & finite
+        )
+        for k in stale:
+            row_min[k] = dist[k].min()
+            row_arg[k] = int(dist[k].argmin())
+        sizes[i] += sizes[j]
+        cluster_ids[i] = n + len(merges) - 1
+        members[i].extend(members.pop(j))
+        merge_members.append(list(members[i]))
+        num_active -= 1
+    # labels: replay merges up to the stop point
+    stop_at = next_merge_stopped if next_merge_stopped is not None else len(merges)
+    pred = np.arange(n, dtype=np.int64)
+    for rows in merge_members[:stop_at]:
+        pred[rows] = min(pred[r] for r in rows)
+    _, pred = np.unique(pred, return_inverse=True)
+    return pred.astype(np.int32), merges
+
+
 class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
@@ -108,94 +191,58 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
                 "ward. Ward only works with euclidean."
             )
         X = as_dense_matrix(table.column(self.get_features_col()))
-        n = X.shape[0]
         num_clusters = self.get_num_clusters()
         threshold = self.get_distance_threshold()
         if threshold is not None:
             num_clusters = 1  # threshold decides instead (reference semantics)
         measure = DistanceMeasure.get_instance(measure_name)
+        compute_full_tree = self.get_compute_full_tree()
 
-        import jax.numpy as jnp
-
-        dist = np.asarray(measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64)
-        np.fill_diagonal(dist, np.inf)
-        num_active = n
-        sizes = np.ones(n, dtype=np.int64)
-        # fresh id for every merged cluster (n, n+1, ...) — the reference's
-        # reOrderNnChain convention for the merge log
-        cluster_ids = list(range(n))
-        members = {i: [i] for i in range(n)}
-        merges = []  # (id1, id2, distance, merged size)
-        merge_members = []  # row sets merged at each step, for labeling
-        next_merge_stopped = None  # merge count at which the stop criterion hit
-        # cached per-row nearest neighbours: the global closest pair is then
-        # an O(n) scan instead of an O(n^2) full-matrix argmin per merge —
-        # the difference between O(n^3) and ~O(n^2) total (the r3 benchmark
-        # ran this loop at 90.6 records/s)
-        row_min = dist.min(axis=1)
-        row_arg = dist.argmin(axis=1)
-        row_ids = np.arange(n)
-        while num_active > 1:
-            i = int(np.argmin(row_min))
-            j = int(row_arg[i])
-            d_ij = row_min[i]
-            stop_hit = (
-                threshold is not None and d_ij > threshold
-            ) or (threshold is None and num_active <= num_clusters)
-            if stop_hit and next_merge_stopped is None:
-                next_merge_stopped = len(merges)
-                if not self.get_compute_full_tree():
-                    break
-            # merge j into i (log the pre-merge cluster ids, sorted)
-            id_i, id_j = cluster_ids[i], cluster_ids[j]
-            lo, hi = (id_i, id_j) if id_i < id_j else (id_j, id_i)
-            merges.append((lo, hi, float(d_ij), int(sizes[i] + sizes[j])))
-            # Lance-Williams row update against every other live cluster
-            new_row = _lance_williams_update(
-                dist[i], dist[j], d_ij, sizes[i], sizes[j], sizes, linkage
+        # The windows param picks the rows each LOCAL clustering runs over
+        # (AgglomerativeClustering.java:122-133: windowAllAndProcess +
+        # LocalAgglomerativeClusteringFunction per window).
+        windows = self.get_windows()
+        if isinstance(windows, CountTumblingWindows):
+            size = int(windows.size)
+            # Flink count windows fire only when full: the ragged tail is
+            # dropped, so the output covers floor(n/size)*size rows
+            n_whole = (X.shape[0] // size) * size
+            starts = list(range(0, n_whole, size))
+            kept_rows = np.arange(n_whole)
+        elif isinstance(windows, GlobalWindows):
+            starts = [0] if X.shape[0] else []
+            size = X.shape[0]
+            kept_rows = np.arange(X.shape[0])
+        else:
+            raise NotImplementedError(
+                f"{type(windows).__name__} needs event-/processing-time "
+                "semantics; bounded tables support GlobalWindows and "
+                "CountTumblingWindows (use the online runtime for time "
+                "windows)"
             )
-            finite = np.isfinite(dist[i]) & np.isfinite(dist[j])
-            dist[i, finite] = new_row[finite]
-            dist[finite, i] = new_row[finite]
-            dist[i, i] = np.inf
-            dist[j, :] = np.inf
-            dist[:, j] = np.inf
-            # nearest-neighbour cache maintenance: j dies; i recomputes; a
-            # row whose distance to the merged cluster improved points at i;
-            # a row whose cached nearest was i or j (and didn't improve) is
-            # stale and rescans
-            row_min[j], row_arg[j] = np.inf, j
-            row_min[i], row_arg[i] = dist[i].min(), int(dist[i].argmin())
-            nr = np.where(finite, new_row, np.inf)
-            better = nr < row_min
-            better[i] = False
-            row_min[better] = nr[better]
-            row_arg[better] = i
-            stale = np.flatnonzero(
-                ((row_arg == i) | (row_arg == j)) & ~better & (row_ids != i) & finite
+        preds, all_merges = [], []
+        for start in starts:
+            pred, merges = _cluster_block(
+                X[start : start + size],
+                linkage,
+                measure,
+                num_clusters,
+                threshold,
+                compute_full_tree,
             )
-            for k in stale:
-                row_min[k] = dist[k].min()
-                row_arg[k] = int(dist[k].argmin())
-            sizes[i] += sizes[j]
-            cluster_ids[i] = n + len(merges) - 1
-            members[i].extend(members.pop(j))
-            merge_members.append(list(members[i]))
-            num_active -= 1
-        # labels: replay merges up to the stop point
-        stop_at = next_merge_stopped if next_merge_stopped is not None else len(merges)
-        pred = np.arange(n, dtype=np.int64)
-        for rows in merge_members[:stop_at]:
-            pred[rows] = min(pred[r] for r in rows)
-        _, pred = np.unique(pred, return_inverse=True)
-        pred = pred.astype(np.int32)
-        out = table.with_column(self.get_prediction_col(), pred)
+            preds.append(pred)
+            all_merges.extend(merges)
+        pred = np.concatenate(preds) if preds else np.zeros(0, np.int32)
+        out = table
+        if len(kept_rows) != table.num_rows:
+            out = out.take(kept_rows)
+        out = out.with_column(self.get_prediction_col(), pred)
         merge_table = Table(
             {
-                "clusterId1": [m[0] for m in merges],
-                "clusterId2": [m[1] for m in merges],
-                "distance": [m[2] for m in merges],
-                "sizeOfMergedCluster": [m[3] for m in merges],
+                "clusterId1": [m[0] for m in all_merges],
+                "clusterId2": [m[1] for m in all_merges],
+                "distance": [m[2] for m in all_merges],
+                "sizeOfMergedCluster": [m[3] for m in all_merges],
             }
         )
         return [out, merge_table]

@@ -69,10 +69,10 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
-        pred = jax.jit(jnp.matmul)(
-            jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32)
-        )
+        col = table.column(self.get_features_col())
+        from .. import _linear
+
+        pred = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
         return [
             table.with_column(self.get_prediction_col(), np.asarray(pred, dtype=np.float64))
         ]

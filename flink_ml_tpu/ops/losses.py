@@ -29,38 +29,89 @@ class LossFunc(NamedTuple):
         return self.fn(X, y, w, coeff)
 
 
-def _binary_logistic(X, y, w, coeff) -> LossOut:
-    dot = X @ coeff
+def _logistic_pointwise(dot, y, w):
+    """-> (per-row loss, per-row multiplier); grad = X^T multiplier."""
     label_scaled = 2.0 * y - 1.0
     margin = dot * label_scaled
     # log(1 + exp(-margin)) computed stably
-    loss = jnp.sum(w * jnp.logaddexp(0.0, -margin))
+    loss = w * jnp.logaddexp(0.0, -margin)
     multiplier = w * (-label_scaled / (jnp.exp(margin) + 1.0))
-    grad = X.T @ multiplier
-    return loss, grad, jnp.sum(w)
+    return loss, multiplier
 
 
-def _hinge(X, y, w, coeff) -> LossOut:
-    dot = X @ coeff
+def _hinge_pointwise(dot, y, w):
     label_scaled = 2.0 * y - 1.0
     margin = 1.0 - label_scaled * dot
-    loss = jnp.sum(w * jnp.maximum(0.0, margin))
+    loss = w * jnp.maximum(0.0, margin)
     multiplier = jnp.where(margin > 0.0, -label_scaled * w, 0.0)
-    grad = X.T @ multiplier
-    return loss, grad, jnp.sum(w)
+    return loss, multiplier
 
 
-def _least_square(X, y, w, coeff) -> LossOut:
-    dot = X @ coeff
+def _least_square_pointwise(dot, y, w):
     diff = dot - y
-    loss = jnp.sum(w * 0.5 * diff * diff)
-    grad = X.T @ (w * diff)
-    return loss, grad, jnp.sum(w)
+    loss = w * 0.5 * diff * diff
+    multiplier = w * diff
+    return loss, multiplier
 
 
-BINARY_LOGISTIC_LOSS = LossFunc("binary_logistic", _binary_logistic)
-HINGE_LOSS = LossFunc("hinge", _hinge)
-LEAST_SQUARE_LOSS = LossFunc("least_square", _least_square)
+def _dense(pointwise):
+    """Dense batched loss: dot/grad are MXU matmuls over (B, d) X."""
+
+    def fn(X, y, w, coeff) -> LossOut:
+        loss, multiplier = pointwise(X @ coeff, y, w)
+        return jnp.sum(loss), X.T @ multiplier, jnp.sum(w)
+
+    return fn
+
+
+def sparse_dot(indices, values, coeff):
+    """Masked padded-CSR row dots: -1 indices are padding. The single
+    definition of the padding/masking convention shared by training
+    losses and inference (the batched analogue of the reference's
+    dense x sparse BLAS.dot, BLAS.java:99-117). Returns (dot, safe, vals)
+    so gradient callers reuse the masked operands."""
+    valid = indices >= 0
+    safe = jnp.where(valid, indices, 0)
+    vals = jnp.where(valid, values, 0.0).astype(coeff.dtype)
+    return jnp.sum(vals * coeff[safe], axis=1), safe, vals
+
+
+def _sparse(pointwise):
+    """Padded-CSR batched loss: X = (indices[B, k] int32 with -1 padding,
+    values[B, k]). The per-row dot is a masked gather-and-sum and the
+    gradient a scatter-add — the batched analogue of the reference's
+    dense x sparse BLAS kernels (flink-ml-core/.../linalg/BLAS.java:69-117
+    axpy/dot over SparseVector indices)."""
+
+    def fn(X, y, w, coeff) -> LossOut:
+        indices, values = X
+        dot, safe, vals = sparse_dot(indices, values, coeff)
+        loss, multiplier = pointwise(dot, y, w)
+        grad = jnp.zeros_like(coeff).at[safe].add(
+            vals * multiplier[:, None], mode="drop"
+        )
+        return jnp.sum(loss), grad, jnp.sum(w)
+
+    return fn
+
+
+BINARY_LOGISTIC_LOSS = LossFunc("binary_logistic", _dense(_logistic_pointwise))
+HINGE_LOSS = LossFunc("hinge", _dense(_hinge_pointwise))
+LEAST_SQUARE_LOSS = LossFunc("least_square", _dense(_least_square_pointwise))
+
+SPARSE_BINARY_LOGISTIC_LOSS = LossFunc(
+    "sparse_binary_logistic", _sparse(_logistic_pointwise)
+)
+SPARSE_HINGE_LOSS = LossFunc("sparse_hinge", _sparse(_hinge_pointwise))
+SPARSE_LEAST_SQUARE_LOSS = LossFunc(
+    "sparse_least_square", _sparse(_least_square_pointwise)
+)
+
+SPARSE_VARIANTS = {
+    BINARY_LOGISTIC_LOSS.name: SPARSE_BINARY_LOGISTIC_LOSS,
+    HINGE_LOSS.name: SPARSE_HINGE_LOSS,
+    LEAST_SQUARE_LOSS.name: SPARSE_LEAST_SQUARE_LOSS,
+}
 
 
 def predict_raw(X, coeff):

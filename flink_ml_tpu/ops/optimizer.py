@@ -43,6 +43,25 @@ from ..parallel import mesh as mesh_lib
 from .losses import LossFunc
 
 
+def _index_batch(X_b, k):
+    """Select batch k from batched features; X may be a dense array or the
+    sparse (indices, values) tuple — every driver treats features as a
+    pytree so the sparse padded-CSR layout flows through unchanged."""
+    if isinstance(X_b, tuple):
+        return tuple(lax.dynamic_index_in_dim(leaf, k, 0, False) for leaf in X_b)
+    return lax.dynamic_index_in_dim(X_b, k, 0, False)
+
+
+def _slice_rows(X, start, rows):
+    if isinstance(X, tuple):
+        return tuple(lax.dynamic_slice_in_dim(leaf, start, rows, 0) for leaf in X)
+    return lax.dynamic_slice_in_dim(X, start, rows, 0)
+
+
+def _feature_dtype(X):
+    return X[1].dtype if isinstance(X, tuple) else X.dtype
+
+
 def _layout_batches_impl(arr, n, num_batches, batch, b_pad, d_pad, sharding):
     """Device-side batch layout: strip any staging pad beyond the true row
     count n, pad rows to num_batches*batch, reshape to
@@ -135,9 +154,9 @@ def _sgd_train_flat(
     and the result pack. Rows are pre-padded to a batch multiple; absent
     weights are synthesized in-loop as (row_index < n) so padding rows
     contribute nothing and no separate weights program runs."""
-    num_batches = X.shape[0] // batch
-    d = X.shape[-1]
-    dtype = X.dtype
+    num_batches = y.shape[0] // batch
+    d = init_coeff.shape[0]
+    dtype = _feature_dtype(X)
 
     def cond(state):
         _, _, _, epoch, criteria = state
@@ -147,7 +166,7 @@ def _sgd_train_flat(
         coeff, grad, wsum, epoch, _ = state
         k = jnp.mod(epoch, num_batches)
         start = k * batch
-        Xk = lax.dynamic_slice_in_dim(X, start, batch, 0)
+        Xk = _slice_rows(X, start, batch)
         yk = lax.dynamic_slice_in_dim(y, start, batch, 0)
         if has_weights:
             wk = lax.dynamic_slice_in_dim(w, start, batch, 0)
@@ -179,9 +198,9 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
     gradient of the next batch; one extra update lands after termination.
     Returns (final_coeff, final_loss, num_epochs).
     """
-    num_batches = X_b.shape[0]
-    d = X_b.shape[-1]
-    dtype = X_b.dtype
+    num_batches = y_b.shape[0]
+    d = init_coeff.shape[0]
+    dtype = _feature_dtype(X_b)
 
     def cond(state):
         _, _, _, epoch, criteria = state
@@ -190,7 +209,7 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
     def body(state):
         coeff, grad, wsum, epoch, _ = state
         k = jnp.mod(epoch, num_batches)
-        Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
+        Xk = _index_batch(X_b, k)
         yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
         wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
         carry, criteria = _epoch_step(
@@ -275,8 +294,8 @@ def read_train_result(async_result, flag=None):
 def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
     """One host-driven epoch over resident batched data — used when
     checkpointing needs epoch-boundary control on the host."""
-    k = jnp.mod(carry[3], X_b.shape[0])
-    Xk = lax.dynamic_index_in_dim(X_b, k, axis=0, keepdims=False)
+    k = jnp.mod(carry[3], y_b.shape[0])
+    Xk = _index_batch(X_b, k)
     yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
     wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
     return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
@@ -341,7 +360,9 @@ class SGD:
         The checkpointed path is host-driven per epoch and returns host
         values directly."""
         mesh = mesh or mesh_lib.default_mesh()
-        d = np.shape(X)[1]
+        # the model length is the feature dim — X may be sparse (indices,
+        # values), whose second axis is the nnz width, not the dim
+        d = int(np.shape(init_coeff)[0])
         if (
             not self.shard_features
             and self.checkpoint_dir is None
@@ -554,27 +575,40 @@ class SGD:
         are placed on the mesh's device (a 1-device mesh may deliberately
         pin a fit to a non-default chip); already-device-resident inputs
         stay where they are."""
-        n = int(np.shape(X)[0])
+        n = int(np.shape(X[0] if isinstance(X, tuple) else X)[0])
         B = int(self.global_batch_size)
         num_batches = max(1, -(-n // B))
         n_pad = num_batches * B
 
-        def stage(arr):
+        def stage(arr, dtype=None):
             if arr is None:
                 return None
+            dtype = dtype or self.dtype
             if isinstance(arr, jax.Array):
-                return arr.astype(self.dtype) if arr.dtype != self.dtype else arr
+                return arr.astype(dtype) if arr.dtype != dtype else arr
             arr = np.asarray(arr)
             return jax.device_put(
-                arr.astype(self.dtype) if arr.dtype != self.dtype else arr,
+                arr.astype(dtype) if arr.dtype != dtype else arr,
                 mesh_lib.data_sharding(mesh, arr.ndim),
             )
 
-        X_f, y_f, w_f = stage(X), stage(y), stage(weights)
+        if isinstance(X, tuple):
+            # sparse padded-CSR: indices keep their integer dtype; padding
+            # rows get index -1 (masked in the sparse losses)
+            X_f = (stage(X[0], np.int32), stage(X[1]))
+        else:
+            X_f = stage(X)
+        y_f, w_f = stage(y), stage(weights)
         if y_f is None:
             y_f = jnp.zeros((n,), self.dtype)
         if n_pad != n:
-            X_f = jnp.pad(X_f, [(0, n_pad - n), (0, 0)])
+            if isinstance(X_f, tuple):
+                X_f = (
+                    jnp.pad(X_f[0], [(0, n_pad - n), (0, 0)], constant_values=-1),
+                    jnp.pad(X_f[1], [(0, n_pad - n), (0, 0)]),
+                )
+            else:
+                X_f = jnp.pad(X_f, [(0, n_pad - n), (0, 0)])
             y_f = jnp.pad(y_f, (0, n_pad - n))
             if w_f is not None:
                 w_f = jnp.pad(w_f, (0, n_pad - n))
@@ -604,7 +638,7 @@ class SGD:
             save_iteration_checkpoint,
         )
 
-        d = X_b.shape[-1]
+        d = init_coeff.shape[0]  # X_b may be the sparse (indices, values) tuple
         lr = jnp.asarray(self.learning_rate, self.dtype)
         reg = jnp.asarray(self.reg, self.dtype)
         en = jnp.asarray(self.elastic_net, self.dtype)
@@ -637,26 +671,27 @@ class SGD:
         inputs (e.g. benchmark tables generated on chip) transfer nothing.
         All padding/reshaping happens on device (`_layout_batches`), and
         absent weights are synthesized on device (`_default_weights`)."""
-        n = int(np.shape(X)[0])
+        n = int(np.shape(X[0] if isinstance(X, tuple) else X)[0])
         B = int(self.global_batch_size)
         num_batches = max(1, -(-n // B))
         shards = mesh_lib.num_data_shards(mesh)
         b_pad = -(-B // shards) * shards
 
-        def stage(arr):
+        def stage(arr, dtype=None):
             """One flat transfer, row-sharded across the mesh so no single
-            chip stages the whole dataset; cast to self.dtype with minimal
-            host work (halves bytes on the wire for f64 input). Host rows
-            are zero-padded to a shard-divisible count; `_layout_batches`
+            chip stages the whole dataset; cast to the compute dtype with
+            minimal host work (halves bytes on the wire for f64 input). Host
+            rows are zero-padded to a shard-divisible count; `_layout_batches`
             strips that pad via the true n. Returns (array, owned): owned
             buffers were created here and may be donated to the layout."""
+            dtype = dtype or self.dtype
             if isinstance(arr, jax.Array):
-                if arr.dtype != self.dtype:
-                    return arr.astype(self.dtype), True
+                if arr.dtype != dtype:
+                    return arr.astype(dtype), True
                 return arr, False
             arr = np.asarray(arr)
-            if arr.dtype != self.dtype:
-                arr = arr.astype(self.dtype)
+            if arr.dtype != dtype:
+                arr = arr.astype(dtype)
             spec = P(mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 1)))
             sharding = NamedSharding(mesh, spec)
             rows = arr.shape[0]
@@ -688,20 +723,30 @@ class SGD:
             fn = _layout_batches_donating if owned else _layout_batches
             return fn(arr, *args)
 
-        X_b = layout(
-            stage(X),
-            n,
-            num_batches,
-            B,
-            b_pad,
-            d_pad,
-            NamedSharding(
-                mesh,
-                P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
-                if d_pad is not None
-                else P(None, mesh_lib.DATA_AXIS, None),
-            ),
-        )
+        if isinstance(X, tuple):
+            # sparse padded-CSR: neither leaf has a feature axis to shard —
+            # indices reference the (possibly model-sharded) coefficient;
+            # XLA inserts the gather/scatter collectives for the TP layout
+            csr_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS, None))
+            X_b = (
+                layout(stage(X[0], np.int32), n, num_batches, B, b_pad, None, csr_sharding),
+                layout(stage(X[1]), n, num_batches, B, b_pad, None, csr_sharding),
+            )
+        else:
+            X_b = layout(
+                stage(X),
+                n,
+                num_batches,
+                B,
+                b_pad,
+                d_pad,
+                NamedSharding(
+                    mesh,
+                    P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+                    if d_pad is not None
+                    else P(None, mesh_lib.DATA_AXIS, None),
+                ),
+            )
         row_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
         y_b = layout(stage(y), n, num_batches, B, b_pad, None, row_sharding)
         if weights is None:

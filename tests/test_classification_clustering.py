@@ -142,3 +142,63 @@ class TestAgglomerativeClustering:
         op.save(str(tmp_path / "agg"))
         loaded = AgglomerativeClustering.load(str(tmp_path / "agg"))
         assert loaded.get_num_clusters() == 3
+
+
+class TestAgglomerativeWindows:
+    """HasWindows drives per-window LOCAL clustering
+    (AgglomerativeClustering.java:122-133 windowAllAndProcess)."""
+
+    def _table(self):
+        rng = np.random.RandomState(0)
+        # two tight blobs per window-of-4, 12 rows total
+        X = rng.rand(12, 3) * 0.01 + (np.arange(12) % 2)[:, None]
+        return Table({"features": X})
+
+    def test_count_tumbling_changes_output(self):
+        from flink_ml_tpu.common.window import CountTumblingWindows
+        from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+            AgglomerativeClustering,
+        )
+
+        t = self._table()
+        base = AgglomerativeClustering().set_num_clusters(2)
+        out_global, merges_global = base.transform(t)
+        windowed = (
+            AgglomerativeClustering()
+            .set_num_clusters(2)
+            .set_windows(CountTumblingWindows.of(4))
+        )
+        out_win, merges_win = windowed.transform(t)
+        # per-window clustering: labels restart per window, merge log is the
+        # concatenation of the 3 local logs (each window of 4 -> 2 merges)
+        assert out_win.num_rows == 12 and out_global.num_rows == 12
+        pred = np.asarray(out_win.column("prediction"))
+        assert set(pred) == {0, 1}
+        assert merges_win.num_rows == 3 * 2
+        assert merges_win.num_rows != merges_global.num_rows
+
+    def test_ragged_tail_dropped(self):
+        from flink_ml_tpu.common.window import CountTumblingWindows
+        from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+            AgglomerativeClustering,
+        )
+
+        t = self._table()  # 12 rows; window 5 -> 2 full windows, 2 rows dropped
+        out, _ = (
+            AgglomerativeClustering()
+            .set_num_clusters(2)
+            .set_windows(CountTumblingWindows.of(5))
+            .transform(t)
+        )
+        assert out.num_rows == 10
+
+    def test_time_windows_rejected(self):
+        from flink_ml_tpu.common.window import EventTimeTumblingWindows
+        from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+            AgglomerativeClustering,
+        )
+
+        with pytest.raises(NotImplementedError, match="time"):
+            AgglomerativeClustering().set_windows(
+                EventTimeTumblingWindows.of(1000)
+            ).transform(self._table())
