@@ -1,0 +1,20 @@
+"""AgglomerativeClustering with a merge-log side output (reference:
+pyflink/examples/ml/clustering/agglomerativeclustering_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+    AgglomerativeClustering,
+)
+
+X = np.array([[0.0, 0.0], [0.1, 0.0], [5.0, 5.0], [5.1, 5.0], [10.0, 0.0]])
+outputs, merge_log = (
+    AgglomerativeClustering().set_num_clusters(3).set_linkage("average").transform(
+        Table({"features": X})
+    )
+)
+pred = np.asarray(outputs.column("prediction"))
+print("labels:", pred)
+print("merges:", merge_log.collect())
+assert pred[0] == pred[1] and pred[2] == pred[3] and pred[4] not in (pred[0], pred[2])

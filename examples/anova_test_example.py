@@ -1,0 +1,15 @@
+"""ANOVATest F-statistics (reference:
+pyflink/examples/ml/stats/anovatest_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.stats.anovatest import ANOVATest
+
+rng = np.random.default_rng(8)
+X = rng.random((40, 3))
+y = (X[:, 0] > 0.5).astype(float)
+out = ANOVATest().transform(Table({"features": X, "label": y}))[0]
+row = out.collect()[0]
+print("pValues:", row["pValues"])
+assert row["pValues"].size() == 3

@@ -1,0 +1,22 @@
+"""BinaryClassificationEvaluator — AUC/AUPR/KS/Lorenz on device (reference:
+pyflink/examples/ml/evaluation/binaryclassificationevaluator_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.evaluation.binaryclassification import (
+    BinaryClassificationEvaluator,
+)
+
+rng = np.random.default_rng(6)
+scores = rng.random(1000)
+labels = (rng.random(1000) < scores).astype(float)
+raw = np.stack([1 - scores, scores], axis=1)
+result = (
+    BinaryClassificationEvaluator()
+    .set_metrics_names("areaUnderROC", "areaUnderPR", "ks", "areaUnderLorenz")
+    .transform(Table({"label": labels, "rawPrediction": raw}))[0]
+    .collect()[0]
+)
+print({k: round(v, 4) for k, v in result.items()})
+assert 0.7 < result["areaUnderROC"] < 1.0

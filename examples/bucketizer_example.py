@@ -1,0 +1,18 @@
+"""Bucketizer split-based binning (reference:
+pyflink/examples/ml/feature/bucketizer_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+
+t = Table({"f1": [-0.5, 0.5, 1.5]})
+out = (
+    Bucketizer()
+    .set_input_cols("f1")
+    .set_output_cols("b1")
+    .set_splits_array([[-float("inf"), 0.0, 1.0, float("inf")]])
+    .transform(t)[0]
+)
+print(np.asarray(out.column("b1")))
+np.testing.assert_array_equal(np.asarray(out.column("b1")), [0.0, 1.0, 2.0])

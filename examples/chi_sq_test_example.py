@@ -1,0 +1,18 @@
+"""ChiSqTest independence statistics (reference:
+pyflink/examples/ml/stats/chisqtest_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.stats.chisqtest import ChiSqTest
+
+t = Table(
+    {
+        "features": [Vectors.dense(0, 1), Vectors.dense(0, 2),
+                     Vectors.dense(1, 1), Vectors.dense(1, 2)] * 5,
+        "label": [0.0, 1.0, 0.0, 1.0] * 5,
+    }
+)
+out = ChiSqTest().transform(t)[0]
+row = out.collect()[0]
+print("pValues:", row["pValues"])
+assert row["pValues"].size() == 2

@@ -1,0 +1,14 @@
+"""CountVectorizer vocabulary learning + term counts (reference:
+pyflink/examples/ml/feature/countvectorizer_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.countvectorizer import CountVectorizer
+
+docs = [["a", "b", "c"], ["a", "b", "b", "c", "a"]]
+t = Table({"input": docs})
+model = CountVectorizer().set_input_col("input").set_output_col("vector").fit(t)
+out = model.transform(t)[0]
+print("vocabulary:", model.vocabulary)
+for row in out.collect():
+    print(row["vector"])
+assert set(model.vocabulary) == {"a", "b", "c"}

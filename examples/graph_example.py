@@ -1,0 +1,28 @@
+"""GraphBuilder DAG of stages (reference:
+flink-ml-examples/.../GraphExample.java, builder/GraphBuilder.java)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.graph import GraphBuilder
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+
+builder = GraphBuilder()
+source = builder.create_table_id()
+scaler = (
+    StandardScaler().set_input_col("features").set_output_col("scaled")
+)
+lr = LogisticRegression().set_features_col("scaled").set_max_iter(20)
+scaled = builder.add_estimator(scaler, [source])
+outputs = builder.add_estimator(lr, [scaled[0]])
+graph = builder.build_estimator([source], [outputs[0]])
+
+rng = np.random.default_rng(10)
+X = np.vstack([rng.normal(1, 0.3, (40, 4)), rng.normal(-1, 0.3, (40, 4))])
+y = np.array([1.0] * 40 + [0.0] * 40)
+model = graph.fit(Table({"features": X, "label": y}))
+out = model.transform(Table({"features": X, "label": y}))[0]
+acc = (np.asarray(out.column("prediction")) == y).mean()
+print("accuracy:", acc)
+assert acc > 0.9

@@ -1,0 +1,16 @@
+"""Tokenizer -> HashingTF term-frequency pipeline (reference:
+pyflink/examples/ml/feature/hashingtf_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.hashingtf import HashingTF
+from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+t = Table({"sentence": ["hashingTF is a transformer", "it hashes terms"]})
+tokens = Tokenizer().set_input_col("sentence").set_output_col("words").transform(t)[0]
+out = (
+    HashingTF().set_input_col("words").set_output_col("tf").set_num_features(128)
+    .transform(tokens)[0]
+)
+for row in out.collect():
+    print(row["words"], "->", row["tf"])
+assert out.collect()[0]["tf"].size() == 128

@@ -1,0 +1,14 @@
+"""Imputer missing-value completion (reference:
+pyflink/examples/ml/feature/imputer_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.imputer import Imputer
+
+t = Table({"f1": [1.0, 2.0, float("nan"), 5.0]})
+model = Imputer().set_input_cols("f1").set_output_cols("o1").fit(t)
+out = model.transform(t)[0]
+o = np.asarray(out.column("o1"))
+print(o)
+np.testing.assert_allclose(o[2], (1.0 + 2.0 + 5.0) / 3)

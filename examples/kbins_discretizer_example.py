@@ -1,0 +1,16 @@
+"""KBinsDiscretizer quantile binning (reference:
+pyflink/examples/ml/feature/kbinsdiscretizer_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.kbinsdiscretizer import KBinsDiscretizer
+
+X = np.linspace(0, 10, 50)[:, None]
+model = KBinsDiscretizer().set_strategy("uniform").set_num_bins(5).fit(
+    Table({"input": X})
+)
+out = model.transform(Table({"input": X}))[0]
+bins = np.asarray(out.column("output"))
+print(sorted(set(bins.ravel())))
+assert set(bins.ravel()) == {0.0, 1.0, 2.0, 3.0, 4.0}

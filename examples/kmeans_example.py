@@ -1,0 +1,15 @@
+"""KMeans clustering — Lloyd iterations as MXU matmuls (reference:
+pyflink/examples/ml/clustering/kmeans_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+rng = np.random.default_rng(3)
+X = np.vstack([rng.normal(0, 0.2, (50, 2)), rng.normal(5, 0.2, (50, 2))])
+model = KMeans().set_k(2).set_seed(7).fit(Table({"features": X}))
+out = model.transform(Table({"features": X}))[0]
+pred = np.asarray(out.column("prediction"))
+print("cluster sizes:", np.bincount(pred.astype(int)))
+assert len(set(pred[:50])) == 1 and len(set(pred[50:])) == 1 and pred[0] != pred[-1]

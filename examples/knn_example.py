@@ -1,0 +1,18 @@
+"""Knn brute-force classifier (reference:
+pyflink/examples/ml/classification/knn_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification.knn import Knn
+
+train = Table(
+    {
+        "features": [[0.0, 0.0], [0.2, 0.1], [9.0, 9.0], [9.2, 9.1]],
+        "label": [1.0, 1.0, 2.0, 2.0],
+    }
+)
+model = Knn().set_k(3).fit(train)
+out = model.transform(Table({"features": [[0.1, 0.0], [9.1, 9.0]]}))[0]
+print(np.asarray(out.column("prediction")))
+assert (np.asarray(out.column("prediction")) == [1.0, 2.0]).all()

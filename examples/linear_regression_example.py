@@ -1,0 +1,21 @@
+"""LinearRegression least-squares fit (reference:
+pyflink/examples/ml/regression/linearregression_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.regression.linearregression import LinearRegression
+
+rng = np.random.default_rng(2)
+X = rng.random((200, 4))
+truth = np.array([1.0, -2.0, 3.0, 0.5])
+y = X @ truth
+model = (
+    LinearRegression().set_max_iter(300).set_learning_rate(0.5).fit(
+        Table({"features": X, "label": y})
+    )
+)
+out = model.transform(Table({"features": X}))[0]
+mse = float(np.mean((np.asarray(out.column("prediction")) - y) ** 2))
+print("mse:", mse)
+assert mse < 0.05

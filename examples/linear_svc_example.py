@@ -1,0 +1,16 @@
+"""LinearSVC hinge-loss binary classifier (reference:
+pyflink/examples/ml/classification/linearsvc_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.classification.linearsvc import LinearSVC
+
+rng = np.random.default_rng(1)
+X = np.vstack([rng.normal(2, 0.5, (60, 3)), rng.normal(-2, 0.5, (60, 3))])
+y = np.array([1.0] * 60 + [0.0] * 60)
+model = LinearSVC().set_max_iter(50).fit(Table({"features": X, "label": y}))
+out = model.transform(Table({"features": X}))[0]
+pred = np.asarray(out.column("prediction"))
+print("accuracy:", (pred == y).mean())
+assert (pred == y).mean() > 0.95

@@ -1,0 +1,26 @@
+"""LogisticRegression train + inference (reference:
+pyflink/examples/ml/classification/logisticregression_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+
+train = Table(
+    {
+        "features": [Vectors.dense(1, 2, 3, 4), Vectors.dense(2, 2, 3, 4),
+                     Vectors.dense(3, 2, 3, 4), Vectors.dense(4, 2, 3, 4),
+                     Vectors.dense(11, 3, 4, 5), Vectors.dense(12, 3, 4, 5),
+                     Vectors.dense(13, 3, 4, 5), Vectors.dense(14, 3, 4, 5)],
+        "label": [0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 1.0, 1.0],
+        "weight": [1.0, 2.0, 3.0, 4.0, 1.0, 2.0, 3.0, 4.0],
+    }
+)
+lr = LogisticRegression().set_weight_col("weight").set_max_iter(60)
+model = lr.fit(train)
+out = model.transform(train)[0]
+for row in out.collect():
+    print(row["features"], "->", row["prediction"])
+pred = np.asarray(out.column("prediction"))
+assert (pred == np.asarray(train.column("label"))).all()

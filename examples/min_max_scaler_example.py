@@ -1,0 +1,14 @@
+"""MinMaxScaler range normalization (reference:
+pyflink/examples/ml/feature/minmaxscaler_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.minmaxscaler import MinMaxScaler
+
+X = np.array([[0.0, 3.0], [2.1, 0.0], [4.1, 5.1]])
+model = MinMaxScaler().fit(Table({"input": X}))
+out = model.transform(Table({"input": X}))[0]
+scaled = np.asarray(out.column("output"))
+print(scaled)
+assert scaled.min() >= -1e-6 and scaled.max() <= 1.0 + 1e-6

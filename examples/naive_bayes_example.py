@@ -1,0 +1,20 @@
+"""Multinomial NaiveBayes (reference:
+pyflink/examples/ml/classification/naivebayes_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.models.classification.naivebayes import NaiveBayes
+
+train = Table(
+    {
+        "features": [Vectors.dense(0, 0), Vectors.dense(0, 1),
+                     Vectors.dense(1, 0), Vectors.dense(1, 1)],
+        "label": [11.0, 11.0, 22.0, 22.0],
+    }
+)
+model = NaiveBayes().set_smoothing(1.0).fit(train)
+out = model.transform(train)[0]
+print(np.asarray(out.column("prediction")))
+assert (np.asarray(out.column("prediction")) == [11.0, 11.0, 22.0, 22.0]).all()

@@ -1,0 +1,13 @@
+"""OneHotEncoder to sparse vectors (reference:
+pyflink/examples/ml/feature/onehotencoder_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.onehotencoder import OneHotEncoder
+
+t = Table({"input": [0.0, 1.0, 2.0, 0.0]})
+model = OneHotEncoder().set_input_cols("input").set_output_cols("output").fit(t)
+out = model.transform(t)[0]
+for row in out.collect():
+    print(row["input"], "->", row["output"])
+first = out.collect()[0]["output"]
+assert first.size() == 2  # drop-last leaves 2 of 3 categories

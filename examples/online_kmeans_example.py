@@ -1,0 +1,26 @@
+"""OnlineKMeans — decayed centroid updates over an unbounded stream
+(reference: pyflink/examples/ml/clustering/onlinekmeans_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import StreamTable, Table
+from flink_ml_tpu.models.clustering.onlinekmeans import (
+    OnlineKMeans,
+    generate_random_model_data,
+)
+
+rng = np.random.default_rng(4)
+batches = [
+    Table({"features": np.vstack([rng.normal(0, 0.1, (8, 2)),
+                                  rng.normal(8, 0.1, (8, 2))])})
+    for _ in range(5)
+]
+okm = (
+    OnlineKMeans()
+    .set_global_batch_size(16)
+    .set_initial_model_data(generate_random_model_data(2, 2, 0.0, seed=5))
+)
+model = okm.fit(StreamTable.from_batches(batches))
+model.process_updates()
+print("model version:", model.model_version)
+assert model.model_version == 5

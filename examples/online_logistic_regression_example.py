@@ -1,0 +1,31 @@
+"""OnlineLogisticRegression — FTRL-Proximal over a stream (reference:
+pyflink/examples/ml/classification/onlinelogisticregression_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import StreamTable, Table
+from flink_ml_tpu.linalg import DenseVector
+from flink_ml_tpu.models.classification.onlinelogisticregression import (
+    OnlineLogisticRegression,
+)
+
+rng = np.random.default_rng(5)
+truth = np.array([2.0, -3.0, 1.0])
+
+def batch(n=32):
+    X = rng.random((n, 3)) * 2 - 1
+    y = (X @ truth > 0).astype(float)
+    return Table({"features": X, "label": y})
+
+olr = (
+    OnlineLogisticRegression()
+    .set_global_batch_size(32)
+    .set_initial_model_data(Table({"coefficient": [DenseVector(np.zeros(3))]}))
+)
+model = olr.fit(StreamTable.from_batches([batch() for _ in range(40)]))
+model.process_updates()
+test = batch(200)
+pred = np.asarray(model.transform(test)[0].column("prediction"))
+acc = (pred == np.asarray(test.column("label"))).mean()
+print("model version:", model.model_version, "accuracy:", acc)
+assert acc > 0.9

@@ -1,0 +1,43 @@
+"""Quick start: scale features, train logistic regression, save/load,
+evaluate — the v0 pipeline (reference:
+docs/content/docs/try-flink-ml/python/quick-start.md,
+flink-ml-examples LogisticRegressionExample.java)."""
+
+import shutil
+
+import numpy as np
+
+from flink_ml_tpu import Pipeline, PipelineModel, Table
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.evaluation.binaryclassification import (
+    BinaryClassificationEvaluator,
+)
+from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+
+rng = np.random.default_rng(0)
+X = np.vstack([rng.normal(2.0, 1.0, (500, 8)), rng.normal(-2.0, 1.0, (500, 8))])
+y = np.array([1.0] * 500 + [0.0] * 500)
+train = Table({"features": X, "label": y})
+
+pipeline = Pipeline(
+    [
+        StandardScaler().set_input_col("features").set_output_col("scaled"),
+        LogisticRegression().set_features_col("scaled").set_max_iter(30),
+    ]
+)
+model = pipeline.fit(train)
+
+shutil.rmtree("/tmp/quickstart_model", ignore_errors=True)
+model.save("/tmp/quickstart_model")
+model = PipelineModel.load("/tmp/quickstart_model")
+
+scored = model.transform(train)[0]
+metrics = (
+    BinaryClassificationEvaluator()
+    .set_metrics_names("areaUnderROC", "ks")
+    .transform(scored)[0]
+    .collect()[0]
+)
+accuracy = float((np.asarray(scored.column("prediction")) == y).mean())
+print(f"accuracy={accuracy:.3f} auc={metrics['areaUnderROC']:.3f} ks={metrics['ks']:.3f}")
+assert accuracy > 0.95 and metrics["areaUnderROC"] > 0.95

@@ -1,0 +1,23 @@
+"""Wide sparse logistic regression — Criteo-style dims train natively on
+padded-CSR batches, never densified (dense at dim 100k would already be
+GBs per 1k rows). SURVEY §2.3's feature-sharded TP layout is the same
+engine with shard_features=True on a (data, model) mesh."""
+
+import numpy as np
+
+from flink_ml_tpu import SparseBatch, Table
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+
+DIM = 100_000
+rng = np.random.default_rng(9)
+n, nnz = 2048, 10
+indices = rng.integers(0, DIM, size=(n, nnz)).astype(np.int32)
+values = rng.random((n, nnz))
+hot = rng.choice(DIM, 500, replace=False)
+y = np.isin(indices, hot).any(axis=1).astype(float)
+
+t = Table({"features": SparseBatch(DIM, indices, values), "label": y})
+model = LogisticRegression().set_max_iter(10).set_global_batch_size(512).fit(t)
+out = model.transform(t)[0]
+print("model dim:", model.coefficient.shape, "predictions:", out.num_rows)
+assert model.coefficient.shape == (DIM,)

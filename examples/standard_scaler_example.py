@@ -1,0 +1,16 @@
+"""StandardScaler mean/std normalization (reference:
+pyflink/examples/ml/feature/standardscaler_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+
+X = np.array([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
+model = StandardScaler().set_with_mean(True).set_input_col("input").set_output_col("output").fit(
+    Table({"input": X})
+)
+out = model.transform(Table({"input": X}))[0]
+scaled = np.asarray(out.column("output"))
+print(scaled)
+np.testing.assert_allclose(scaled.mean(axis=0), 0.0, atol=1e-7)

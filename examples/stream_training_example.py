@@ -1,0 +1,24 @@
+"""Out-of-core training: a one-pass StreamTable trains through the native
+spillable data cache with the same batch schedule as in-memory fits
+(reference: the ReplayOperator cache-then-replay contract,
+flink-ml-iteration/.../operator/ReplayOperator.java:125-246)."""
+
+import numpy as np
+
+from flink_ml_tpu import StreamTable, Table
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+
+rng = np.random.default_rng(11)
+truth = np.array([1.0, -1.0, 0.5, 2.0])
+
+def chunk(n=64):
+    X = rng.random((n, 4))
+    return Table({"features": X, "label": (X @ truth > 1.2).astype(float)})
+
+stream = StreamTable(iter([chunk() for _ in range(8)]))
+model = LogisticRegression().set_max_iter(200).set_learning_rate(0.5).set_global_batch_size(128).fit(stream)
+test = chunk(256)
+pred = np.asarray(model.transform(test)[0].column("prediction"))
+acc = (pred == np.asarray(test.column("label"))).mean()
+print("accuracy:", acc)
+assert acc > 0.8

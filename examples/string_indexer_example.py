@@ -1,0 +1,19 @@
+"""StringIndexer + IndexToString round trip (reference:
+pyflink/examples/ml/feature/stringindexer_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.stringindexer import StringIndexer
+
+t = Table({"color": ["red", "blue", "red", "green"]})
+model = (
+    StringIndexer()
+    .set_input_cols("color")
+    .set_output_cols("color_idx")
+    .set_string_order_type("alphabetAsc")
+    .fit(t)
+)
+out = model.transform(t)[0]
+print(np.asarray(out.column("color_idx")))
+np.testing.assert_array_equal(np.asarray(out.column("color_idx")), [2.0, 0.0, 2.0, 1.0])

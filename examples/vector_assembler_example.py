@@ -1,0 +1,15 @@
+"""VectorAssembler column concatenation (reference:
+pyflink/examples/ml/feature/vectorassembler_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.vectorassembler import VectorAssembler
+
+t = Table({"a": [1.0, 2.0], "b": np.array([[10.0, 11.0], [20.0, 21.0]])})
+out = (
+    VectorAssembler().set_input_cols("a", "b").set_output_col("vec").transform(t)[0]
+)
+vec = np.asarray(out.column("vec"))
+print(vec)
+np.testing.assert_array_equal(vec, [[1.0, 10.0, 11.0], [2.0, 20.0, 21.0]])

@@ -1,0 +1,15 @@
+"""vector_to_array / array_to_vector column conversions (reference:
+pyflink/examples/ml/vectortoarray_example.py, Functions.java:10-38)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table, array_to_vector, vector_to_array
+from flink_ml_tpu.linalg import Vectors
+
+t = Table({"vec": [Vectors.sparse(3, [1], [5.0]), Vectors.dense(1.0, 2.0, 3.0)]})
+arrays = vector_to_array(t.column("vec"))
+print(arrays)
+back = array_to_vector(arrays)
+round_tripped = Table({"vec": back})
+np.testing.assert_array_equal(arrays, [[0.0, 5.0, 0.0], [1.0, 2.0, 3.0]])
+assert round_tripped.num_rows == 2

@@ -98,23 +98,25 @@ class LinearSVCModel(Model, LinearSVCModelParams):
         from ...table import SparseBatch
         from .. import _linear
 
+        device_in = False
         if isinstance(col, SparseBatch):  # wide sparse: never densify
             dot = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
             pred, raw = _predict_from_dot(dot, jnp.asarray(self.get_threshold(), jnp.float32))
+            device_in = isinstance(col.indices, jax.Array)
         else:
             pred, raw = _predict(
                 jnp.asarray(as_dense_matrix(col), jnp.float32),
                 jnp.asarray(self.coefficient, jnp.float32),
                 jnp.asarray(self.get_threshold(), jnp.float32),
             )
-        return [
-            table.with_columns(
-                {
-                    self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
-                    self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
-                }
-            )
-        ]
+        if device_in:  # device data in -> device predictions out, no D2H
+            cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
+        else:
+            cols = {
+                self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
+                self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
+            }
+        return [table.with_columns(cols)]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, coefficient=self.coefficient)

@@ -97,7 +97,7 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
         if isinstance(col, SparseBatch):  # wide sparse: never densify
             dot = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
             pred, raw = _predict_from_dot(dot)
-            device_in = True
+            device_in = isinstance(col.indices, jax.Array)
         else:
             X = as_dense_matrix(col, allow_device=True)
             device_in = isinstance(X, jax.Array)

@@ -220,6 +220,7 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
                 "CountTumblingWindows (use the online runtime for time "
                 "windows)"
             )
+        n_total = len(kept_rows)
         preds, all_merges = [], []
         for start in starts:
             pred, merges = _cluster_block(
@@ -231,7 +232,23 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
                 compute_full_tree,
             )
             preds.append(pred)
-            all_merges.extend(merges)
+            # remap window-local cluster ids to global ones so the
+            # concatenated merge log stays decodable: local row id i ->
+            # global row start+i; local merged id local_n+j (the window's
+            # j-th merge) -> n_total + (merges logged so far) + j — the
+            # same "rows first, then merges in log order" convention the
+            # single-window output uses
+            local_n = len(pred)
+            merge_base = n_total + len(all_merges)
+
+            def remap(cid, start=start, local_n=local_n, merge_base=merge_base):
+                if cid < local_n:
+                    return cid + start
+                return merge_base + (cid - local_n)
+
+            all_merges.extend(
+                (remap(a), remap(b), dist_, size_) for a, b, dist_, size_ in merges
+            )
         pred = np.concatenate(preds) if preds else np.zeros(0, np.int32)
         out = table
         if len(kept_rows) != table.num_rows:

@@ -202,3 +202,27 @@ class TestAgglomerativeWindows:
             AgglomerativeClustering().set_windows(
                 EventTimeTumblingWindows.of(1000)
             ).transform(self._table())
+
+
+class TestWindowedMergeLogDecodable:
+    def test_merge_ids_globally_unique(self):
+        from flink_ml_tpu.common.window import CountTumblingWindows
+        from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+            AgglomerativeClustering,
+        )
+
+        rng = np.random.RandomState(1)
+        X = rng.rand(12, 3) * 0.01 + (np.arange(12) % 2)[:, None]
+        out, merges = (
+            AgglomerativeClustering()
+            .set_num_clusters(2)
+            .set_windows(CountTumblingWindows.of(4))
+            .transform(Table({"features": X}))
+        )
+        rows = merges.collect()
+        ids = [r["clusterId1"] for r in rows] + [r["clusterId2"] for r in rows]
+        assert len(ids) == len(set(ids))  # no collisions across windows
+        # every id is either a global row index (< 12) or a merged-cluster
+        # id in log order (12 + merge_index)
+        merged_ids = sorted(i for i in ids if i >= 12)
+        assert all(i < 12 + len(rows) for i in merged_ids)
