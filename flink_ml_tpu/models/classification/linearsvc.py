@@ -104,8 +104,10 @@ class LinearSVCModel(Model, LinearSVCModelParams):
             pred, raw = _predict_from_dot(dot, jnp.asarray(self.get_threshold(), jnp.float32))
             device_in = isinstance(col.indices, jax.Array)
         else:
+            X = as_dense_matrix(col, allow_device=True)
+            device_in = isinstance(X, jax.Array)
             pred, raw = _predict(
-                jnp.asarray(as_dense_matrix(col), jnp.float32),
+                jnp.asarray(X, jnp.float32),
                 jnp.asarray(self.coefficient, jnp.float32),
                 jnp.asarray(self.get_threshold(), jnp.float32),
             )
@@ -122,7 +124,12 @@ class LinearSVCModel(Model, LinearSVCModelParams):
         read_write.save_model_arrays(path, coefficient=self.coefficient)
 
     def _load_extra(self, path: str) -> None:
-        self.coefficient = read_write.load_model_arrays(path)["coefficient"]
+        from ...utils import javacodec
+
+        loaded = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_coefficient
+        )
+        self.coefficient = loaded["coefficient"] if isinstance(loaded, dict) else loaded
 
 
 class LinearSVC(Estimator, LinearSVCParams):

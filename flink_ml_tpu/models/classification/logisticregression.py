@@ -115,7 +115,14 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
         read_write.save_model_arrays(path, coefficient=self.coefficient)
 
     def _load_extra(self, path: str) -> None:
-        self.coefficient = read_write.load_model_arrays(path)["coefficient"]
+        from ...utils import javacodec
+
+        loaded = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_logisticregression
+        )
+        self.coefficient = (
+            loaded["coefficient"] if isinstance(loaded, dict) else loaded[0]
+        )
 
 
 class LogisticRegression(Estimator, LogisticRegressionParams):

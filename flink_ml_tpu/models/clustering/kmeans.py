@@ -138,8 +138,15 @@ class KMeansModel(Model, KMeansModelParams):
         read_write.save_model_arrays(path, centroids=self.centroids, weights=self.weights)
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
-        self.centroids, self.weights = arrays["centroids"], arrays["weights"]
+        from ...utils import javacodec
+
+        loaded = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_kmeans
+        )
+        if isinstance(loaded, dict):
+            self.centroids, self.weights = loaded["centroids"], loaded["weights"]
+        else:  # reference binary (KMeansModelData.ModelDataEncoder)
+            self.centroids, self.weights = loaded
 
 
 @partial(jax.jit, static_argnames=("measure_name",))

@@ -81,7 +81,12 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
         read_write.save_model_arrays(path, coefficient=self.coefficient)
 
     def _load_extra(self, path: str) -> None:
-        self.coefficient = read_write.load_model_arrays(path)["coefficient"]
+        from ...utils import javacodec
+
+        loaded = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_coefficient
+        )
+        self.coefficient = loaded["coefficient"] if isinstance(loaded, dict) else loaded
 
 
 class LinearRegression(Estimator, LinearRegressionParams):

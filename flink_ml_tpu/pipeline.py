@@ -55,7 +55,7 @@ class PipelineModel(Model):
         num_stages = int(metadata.get("numStages", metadata.get("num_stages", 0)))
         stages = [
             read_write.load_stage(
-                read_write.get_path_for_pipeline_stage(i, num_stages, path)
+                read_write.resolve_pipeline_stage_path(i, num_stages, path)
             )
             for i in range(num_stages)
         ]
@@ -108,7 +108,7 @@ class Pipeline(Estimator):
         num_stages = int(metadata.get("numStages", metadata.get("num_stages", 0)))
         stages = [
             read_write.load_stage(
-                read_write.get_path_for_pipeline_stage(i, num_stages, path)
+                read_write.resolve_pipeline_stage_path(i, num_stages, path)
             )
             for i in range(num_stages)
         ]

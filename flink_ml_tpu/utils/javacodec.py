@@ -1,0 +1,164 @@
+"""Binary codecs for model data written by the reference's Java encoders.
+
+The reference persists model data as binary part files under
+`{stage_path}/data/`, one encoder per model class
+(ReadWriteUtils.saveModelData/loadModelData,
+flink-ml-core/.../util/ReadWriteUtils.java:440-460). The wire format is
+Java DataOutput (big-endian):
+
+- DenseVector  (linalg/typeinfo/DenseVectorSerializer.java:78-99):
+  int32 length + length x float64 values.
+- KMeansModelData  (clustering/kmeans/KMeansModelData.java:140-154):
+  int32 numCentroids + numCentroids x DenseVector + weights DenseVector.
+- LogisticRegressionModelData
+  (classification/logisticregression/LogisticRegressionModelData.java:
+  110-121): DenseVector coefficient + int64 modelVersion.
+- LinearSVCModelData / LinearRegressionModelData mirror the LR layout
+  minus the version long (a single DenseVector coefficient).
+
+These codecs let models LOAD reference-written directories (the npz
+native format stays the default for save) and write reference-format
+fixtures for tests. Encoders/decoders are exact inverses; the committed
+fixture under tests/fixtures/ was produced by the encoders here,
+implementing the cited Java formats byte for byte.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_INT = struct.Struct(">i")
+_LONG = struct.Struct(">q")
+
+
+def encode_dense_vector(values: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(np.asarray(values, dtype=np.float64))
+    return _INT.pack(arr.shape[0]) + arr.astype(">f8").tobytes()
+
+
+def read_dense_vector(stream: io.BufferedIOBase) -> np.ndarray:
+    raw = stream.read(4)
+    if len(raw) < 4:
+        raise EOFError("end of stream")
+    (length,) = _INT.unpack(raw)
+    data = stream.read(8 * length)
+    if len(data) < 8 * length:
+        raise EOFError("truncated DenseVector payload")
+    return np.frombuffer(data, dtype=">f8").astype(np.float64)
+
+
+def encode_kmeans_model_data(centroids: np.ndarray, weights: np.ndarray) -> bytes:
+    out = [_INT.pack(int(np.shape(centroids)[0]))]
+    for c in np.asarray(centroids, dtype=np.float64):
+        out.append(encode_dense_vector(c))
+    out.append(encode_dense_vector(weights))
+    return b"".join(out)
+
+
+def read_kmeans_model_data(stream) -> Tuple[np.ndarray, np.ndarray]:
+    raw = stream.read(4)
+    if len(raw) < 4:
+        raise EOFError("end of stream")
+    (num,) = _INT.unpack(raw)
+    centroids = np.stack([read_dense_vector(stream) for _ in range(num)])
+    weights = read_dense_vector(stream)
+    return centroids, weights
+
+
+def encode_logisticregression_model_data(
+    coefficient: np.ndarray, model_version: int = 0
+) -> bytes:
+    return encode_dense_vector(coefficient) + _LONG.pack(int(model_version))
+
+
+def read_logisticregression_model_data(stream) -> Tuple[np.ndarray, int]:
+    coefficient = read_dense_vector(stream)
+    raw = stream.read(8)
+    if len(raw) < 8:
+        raise EOFError("truncated modelVersion")
+    (version,) = _LONG.unpack(raw)
+    return coefficient, version
+
+
+def encode_coefficient_model_data(coefficient: np.ndarray) -> bytes:
+    """LinearSVCModelData / LinearRegressionModelData: one DenseVector."""
+    return encode_dense_vector(coefficient)
+
+
+def _part_sort_key(path: str):
+    """Numeric-aware part-file ordering: 'part-0-10' sorts after 'part-0-9'
+    (plain lexical order would make records[-1] a stale model once a
+    writer produces 10+ parts)."""
+    name = os.path.basename(path)
+    pieces = name.replace("_", "-").split("-")
+    return [int(p) if p.isdigit() else p for p in pieces]
+
+
+def _data_files(stage_path: str) -> List[str]:
+    """The binary part files under {stage_path}/data (everything that is
+    not the native npz container), in numeric-aware name order."""
+    data_dir = os.path.join(stage_path, "data")
+    return sorted(
+        (
+            f
+            for f in glob.glob(os.path.join(data_dir, "*"))
+            if os.path.isfile(f) and not f.endswith(".npz")
+        ),
+        key=_part_sort_key,
+    )
+
+
+def _iter_records(stage_path: str, read_one) -> Iterator:
+    for file_path in _data_files(stage_path):
+        with open(file_path, "rb") as f:
+            stream = io.BufferedReader(f)
+            while True:
+                if not stream.peek(1):  # clean end of file
+                    break
+                try:
+                    yield read_one(stream)
+                except EOFError as e:  # mid-record cut = corruption, not EOF
+                    raise IOError(
+                        f"Corrupt reference model data file {file_path}: {e}"
+                    ) from e
+
+
+def load_reference_kmeans(stage_path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Decode a reference-written KMeans model directory; None if no
+    binary part files exist."""
+    records = list(_iter_records(stage_path, read_kmeans_model_data))
+    if not records:
+        return None
+    # bounded KMeans writes one record; online writers append versions —
+    # the LAST record is the current model (OnlineKMeansModel semantics)
+    return records[-1]
+
+
+def load_reference_logisticregression(stage_path: str) -> Optional[Tuple[np.ndarray, int]]:
+    records = list(_iter_records(stage_path, read_logisticregression_model_data))
+    if not records:
+        return None
+    return records[-1]
+
+
+def load_reference_coefficient(stage_path: str) -> Optional[np.ndarray]:
+    records = list(_iter_records(stage_path, read_dense_vector))
+    if not records:
+        return None
+    return records[-1]
+
+
+def write_reference_data_file(stage_path: str, payload: bytes, part: int = 0) -> str:
+    """Write a reference-layout binary part file (fixture/export helper)."""
+    data_dir = os.path.join(stage_path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    path = os.path.join(data_dir, f"part-0-{part}")
+    with open(path, "wb") as f:
+        f.write(payload)
+    return path

@@ -114,11 +114,43 @@ def load_model_arrays(path: str, name: str = "model_data") -> Dict[str, np.ndarr
         return {k: f[k] for k in f.files}
 
 
+def load_arrays_or_reference(path: str, reference_decoder, name: str = "model_data"):
+    """Model-data loading shared by every model's `_load_extra`: the native
+    npz container when present, else `reference_decoder(path)` for a
+    reference-written binary directory (utils/javacodec.py), else a
+    FileNotFoundError naming both accepted formats."""
+    if model_data_exists(path, name):
+        return load_model_arrays(path, name)
+    decoded = reference_decoder(path)
+    if decoded is None:
+        raise FileNotFoundError(
+            f"No model data under {get_data_path(path)}: neither the native "
+            "npz container nor reference-format binary part files"
+        )
+    return decoded
+
+
 def model_data_exists(path: str, name: str = "model_data") -> bool:
     return os.path.exists(os.path.join(get_data_path(path), name + ".npz"))
 
 
 def get_path_for_pipeline_stage(index: int, num_stages: int, path: str) -> str:
-    """`stages/{zero-padded idx}` layout (ReadWriteUtils.java:193-246)."""
-    width = max(len(str(num_stages - 1)), 5)
+    """`stages/{zero-padded idx}` layout, padded to len(str(numStages))
+    exactly as the reference does (ReadWriteUtils.java:193-198:
+    format "stages/%0{len(str(numStages))}d") so directories cross-load."""
+    width = len(str(num_stages))
     return os.path.join(path, "stages", str(index).zfill(width))
+
+
+def resolve_pipeline_stage_path(index: int, num_stages: int, path: str) -> str:
+    """Stage dir for loading: the reference-width name, falling back to the
+    legacy 5-wide padding this framework wrote before aligning."""
+    primary = get_path_for_pipeline_stage(index, num_stages, path)
+    if os.path.isdir(primary):
+        return primary
+    legacy = os.path.join(
+        path, "stages", str(index).zfill(max(len(str(num_stages - 1)), 5))
+    )
+    if os.path.isdir(legacy):
+        return legacy
+    return primary
