@@ -162,6 +162,9 @@ class DoubleGenerator(DataGenerator):
         return self.set(self.ARITY, value)
 
     def get_data(self) -> List[Table]:
+        # scalar columns stay host-born: numpy generates ~1e8 doubles/s and
+        # the scalar-consuming stages (bucketizer, binarizer, imputer, SQL)
+        # are host-columnar — device birth would just force D2H round trips
         (names,) = self.get_col_names()
         rng = self._rng()
         n, arity = self.get_num_values(), self.get_arity()

@@ -85,6 +85,7 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
     with timed_phase("datagen"):
         stage = read_write.instantiate_with_params(entry["stage"])
         input_tables = instantiate_generator(entry["inputData"]).get_data()
+        _adapt_input_columns(stage, input_tables)
         model_tables: Optional[List[Table]] = None
         if "modelData" in entry:
             model_tables = instantiate_generator(entry["modelData"]).get_data()
@@ -126,6 +127,33 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "outputThroughput": num_output * 1000.0 / elapsed_ms if elapsed_ms else 0.0,
         "phaseTimesMs": {k: v * 1000.0 for k, v in phases.items()},
     }
+
+
+def _adapt_input_columns(stage, input_tables: List[Table]) -> None:
+    """Compensate for broken upstream benchmark configs: several reference
+    configs (normalizer, maxabsscaler, vectorslicer, elementwiseproduct,
+    polynoimalexpansion) generate a single column named 'featuresCol' while
+    the stage's input/features param keeps its default ('input'/'features')
+    — the stage would fail on the reference too. When the stage's input
+    column is missing and the generated table has exactly one column, point
+    the stage at that column and log the adaptation."""
+    if len(input_tables) != 1 or len(input_tables[0].column_names) != 1:
+        return
+    only_col = input_tables[0].column_names[0]
+    for getter, setter in (
+        ("get_input_col", "set_input_col"),
+        ("get_features_col", "set_features_col"),
+    ):
+        if hasattr(stage, getter):
+            current = getattr(stage, getter)()
+            if current not in input_tables[0] and only_col != current:
+                getattr(stage, setter)(only_col)
+                print(
+                    f"  [config-adapt] {type(stage).__name__}.{getter[4:]}: "
+                    f"{current!r} -> {only_col!r} (column absent from generated table)",
+                    file=sys.stderr,
+                )
+            return
 
 
 def _block_until_ready(tables: List[Table]) -> None:

@@ -123,16 +123,14 @@ class KMeansModel(Model, KMeansModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
         measure = DistanceMeasure.get_instance(self.get_distance_measure())
         assign = jax.jit(measure.find_closest)(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
         )
-        return [
-            table.with_column(
-                self.get_prediction_col(), np.asarray(assign, dtype=np.int32)
-            )
-        ]
+        if not isinstance(X, jax.Array):  # host in -> host out
+            assign = np.asarray(assign, dtype=np.int32)
+        return [table.with_column(self.get_prediction_col(), assign)]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, centroids=self.centroids, weights=self.weights)

@@ -50,8 +50,10 @@ def _dct_basis(n: int) -> np.ndarray:
 class DCT(Transformer, DCTParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         B = _dct_basis(X.shape[1])
         mat = B.T if self.get_inverse() else B
-        out = jax.jit(jnp.matmul)(jnp.asarray(X), jnp.asarray(mat.T))
-        return [table.with_column(self.get_output_col(), np.asarray(out))]
+        out = jax.jit(jnp.matmul)(jnp.asarray(X, jnp.float32), jnp.asarray(mat.T, jnp.float32))
+        if not isinstance(X, jax.Array):
+            out = np.asarray(out)
+        return [table.with_column(self.get_output_col(), out)]

@@ -45,7 +45,7 @@ class ElementwiseProduct(Transformer, ElementwiseProductParams):
             gathered = np.where(col.indices >= 0, sv[np.clip(col.indices, 0, None)], 0.0)
             out = SparseBatch(col.size, col.indices.copy(), col.values * gathered)
         else:
-            X = as_dense_matrix(col)
+            X = as_dense_matrix(col, allow_device=True)
             if X.shape[1] != sv.shape[0]:
                 raise ValueError(
                     f"Vector size {X.shape[1]} does not match scalingVec size {sv.shape[0]}"

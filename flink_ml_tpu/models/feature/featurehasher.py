@@ -15,8 +15,11 @@ import numpy as np
 
 from ...api import Transformer
 from ...common.param import HasCategoricalCols, HasInputCols, HasNumFeatures, HasOutputCol
-from ...table import Table, rows_to_sparse_batch
-from ...utils.hashing import murmur3_hash_unencoded_chars
+from ...table import SparseBatch, Table, rows_to_sparse_batch
+from ...utils.hashing import (
+    murmur3_batch_unencoded_chars,
+    murmur3_hash_unencoded_chars,
+)
 
 
 def _hash_index(s: str, num_features: int) -> int:
@@ -55,6 +58,44 @@ class FeatureHasher(Transformer, FeatureHasherParams):
                 return "true" if v else "false"
             return str(v)
 
+        host_cols = {c: np.asarray(table.column(c)) for c in input_cols}
+        vectorizable = all(
+            arr.ndim == 1 and arr.dtype.kind in "fiub" for arr in host_cols.values()
+        )
+        if vectorizable and input_cols:
+            # vectorized path: bucket indices come from batch murmur over
+            # `col=value` strings (categorical) or the column-name hash
+            # (numeric, one constant bucket per column, value summed); the
+            # per-row dict loop below is minutes at the benchmark's 10M rows
+            idx_cols, val_cols = [], []
+            for c in numeric_cols:
+                idx_cols.append(
+                    np.full(n, _hash_index(c, n_features), np.int64)
+                )
+                val_cols.append(host_cols[c].astype(np.float64))
+            for c in input_cols:
+                if c not in categorical:
+                    continue
+                values = host_cols[c]
+                if values.dtype.kind == "b":
+                    # java_str: Java Boolean.toString is lowercase
+                    rendered = np.where(values, "true", "false")
+                else:
+                    rendered = values.astype(str)
+                strs = np.char.add(f"{c}=", rendered)
+                h = murmur3_batch_unencoded_chars(strs)
+                h = np.where(h == -(2**31), h, np.abs(h))
+                idx_cols.append(h % n_features)
+                val_cols.append(np.ones(n, np.float64))
+            idxs = np.stack(idx_cols, axis=1)
+            vals = np.stack(val_cols, axis=1)
+            indices, values = _combine_hashed(idxs, vals)
+            return [
+                table.with_column(
+                    self.get_output_col(),
+                    SparseBatch(n_features, indices, values),
+                )
+            ]
         features = [dict() for _ in range(n)]
         for col in numeric_cols:
             idx = _hash_index(col, n_features)
@@ -76,3 +117,31 @@ class FeatureHasher(Transformer, FeatureHasherParams):
                 rows_to_sparse_batch(n_features, row_idx, row_val),
             )
         ]
+
+
+def _combine_hashed(idxs: np.ndarray, vals: np.ndarray):
+    """Merge per-row (bucket, value) pairs: equal buckets sum, outputs are
+    padded-CSR (indices ascending per row, -1 padding) — the TreeMap order
+    of FeatureHasher.updateMap, vectorized over all rows at once."""
+    n, k = idxs.shape
+    order = np.argsort(idxs, axis=1, kind="stable")
+    I = np.take_along_axis(idxs, order, axis=1)
+    V = np.take_along_axis(vals, order, axis=1)
+    first = np.ones((n, k), dtype=bool)
+    first[:, 1:] = I[:, 1:] != I[:, :-1]
+    cum = np.cumsum(V, axis=1)
+    pos = np.arange(k)
+    first_pos = np.where(first, pos, k)
+    # next run start after p = min(first_pos[p+1:]) (suffix minimum)
+    suffix = np.minimum.accumulate(first_pos[:, ::-1], axis=1)[:, ::-1]
+    next_first = np.concatenate(
+        [suffix[:, 1:], np.full((n, 1), k, first_pos.dtype)], axis=1
+    )
+    run_end = np.minimum(next_first - 1, k - 1)
+    prev_cum = np.concatenate([np.zeros((n, 1), cum.dtype), cum[:, :-1]], axis=1)
+    run_sum = np.take_along_axis(cum, run_end, axis=1) - prev_cum
+    # compact first-of-run entries to the left, order preserved
+    comp = np.argsort(np.where(first, pos, k), axis=1, kind="stable")
+    indices = np.take_along_axis(np.where(first, I, -1), comp, axis=1).astype(np.int32)
+    values = np.take_along_axis(np.where(first, run_sum, 0.0), comp, axis=1)
+    return indices, values

@@ -75,7 +75,7 @@ class IDFModel(Model, IDFModelParams):
             )
             out = SparseBatch(col.size, col.indices.copy(), col.values * gathered)
         else:
-            out = as_dense_matrix(col) * self.idf[None, :]
+            out = as_dense_matrix(col, allow_device=True) * self.idf[None, :]
         return [table.with_column(self.get_output_col(), out)]
 
     def _save_extra(self, path: str) -> None:
@@ -101,8 +101,17 @@ class IDF(Estimator, IDFParams):
             np.add.at(df, present, 1.0)
             n_docs = col.n
         else:
-            X = as_dense_matrix(col)
-            df = (X != 0).sum(axis=0).astype(np.float64)
+            X = as_dense_matrix(col, allow_device=True)
+            import jax
+
+            if isinstance(X, jax.Array):
+                import jax.numpy as jnp
+
+                df = np.asarray(
+                    jax.jit(lambda a: jnp.sum(a != 0, axis=0))(X), dtype=np.float64
+                )
+            else:
+                df = (X != 0).sum(axis=0).astype(np.float64)
             n_docs = X.shape[0]
         min_df = self.get_min_doc_freq()
         idf = np.where(

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -102,7 +103,31 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col())).copy()
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
+        if isinstance(X, jax.Array):
+            # device binning: pad per-column edges to a common width with
+            # +inf and vmap searchsorted over columns — no 400MB D2H
+            width = max(e.size for e in self.bin_edges)
+            edges_mat = np.full((len(self.bin_edges), width), np.inf)
+            nbins = np.zeros(len(self.bin_edges), np.int32)
+            for j, e in enumerate(self.bin_edges):
+                edges_mat[j, : e.size] = e
+                nbins[j] = max(e.size - 2, 0)
+
+            @jax.jit
+            def bin_all(X, edges_mat, nbins):
+                def one(col, edges, nb):
+                    idx = jnp.searchsorted(edges, col, side="right") - 1
+                    idx = jnp.clip(idx, 0, jnp.maximum(nb, 0))
+                    return jnp.where(nb > 0, idx, 0).astype(col.dtype)
+
+                return jax.vmap(one, in_axes=(1, 0, 0), out_axes=1)(
+                    X, edges_mat, nbins
+                )
+
+            out = bin_all(X, jnp.asarray(edges_mat, X.dtype), jnp.asarray(nbins))
+            return [table.with_column(self.get_output_col(), out)]
+        X = np.asarray(X, dtype=np.float64).copy()
         for j, edges in enumerate(self.bin_edges):
             if edges.size <= 2:
                 X[:, j] = 0.0
@@ -129,7 +154,7 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
 
         if isinstance(table, StreamTable):
             return self._fit_stream(table)
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         sub = self.get_sub_samples()
         if X.shape[0] > sub:
             rng = np.random.RandomState(0)
@@ -137,20 +162,46 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         strategy = self.get_strategy()
         num_bins = self.get_num_bins()
         edges_list: List[np.ndarray] = []
-        for j in range(X.shape[1]):
-            col = X[:, j]
-            if strategy == UNIFORM:
+        # whole-matrix device reductions with ONE readback each; only the
+        # per-column edge cleanup (tiny) runs on host
+        if strategy == UNIFORM:
+            if isinstance(X, jax.Array):
+                lo_hi = np.asarray(
+                    jax.jit(
+                        lambda a: jnp.stack([jnp.min(a, axis=0), jnp.max(a, axis=0)])
+                    )(X),
+                    dtype=np.float64,
+                )
+            else:  # host float64 stays float64 (device cast would round)
+                lo_hi = np.stack([np.min(X, axis=0), np.max(X, axis=0)]).astype(
+                    np.float64
+                )
+            for j in range(X.shape[1]):
                 # unique collapses the constant-feature case to <= 2 edges,
                 # which transform maps to bin 0 (KBinsDiscretizer.java:63-64)
-                edges = np.unique(np.linspace(col.min(), col.max(), num_bins + 1))
-            elif strategy == QUANTILE:
-                qs = np.linspace(0.0, 1.0, num_bins + 1)
-                edges = np.asarray(jnp.quantile(jnp.asarray(col), jnp.asarray(qs)))
-                # collapse duplicate edges as the reference does
-                edges = np.unique(edges)
+                edges_list.append(
+                    np.unique(np.linspace(lo_hi[0, j], lo_hi[1, j], num_bins + 1))
+                )
+        elif strategy == QUANTILE:
+            qs = np.linspace(0.0, 1.0, num_bins + 1)
+            if isinstance(X, jax.Array):
+                all_edges = np.asarray(
+                    jax.jit(jnp.quantile, static_argnames=("axis",))(
+                        X, jnp.asarray(qs, X.dtype), axis=0
+                    ),
+                    dtype=np.float64,
+                )  # (num_bins + 1, d)
             else:
-                edges = _kmeans_1d_edges(col, num_bins)
-            edges_list.append(np.asarray(edges, dtype=np.float64))
+                all_edges = np.quantile(np.asarray(X, np.float64), qs, axis=0)
+            for j in range(X.shape[1]):
+                # collapse duplicate edges as the reference does
+                edges_list.append(np.unique(all_edges[:, j]))
+        else:
+            X_host = np.asarray(X)  # kmeans edges: host 1-D Lloyd per column
+            for j in range(X_host.shape[1]):
+                edges_list.append(
+                    np.asarray(_kmeans_1d_edges(X_host[:, j], num_bins), dtype=np.float64)
+                )
         model = KBinsDiscretizerModel()
         model.bin_edges = edges_list
         update_existing_params(model, self)

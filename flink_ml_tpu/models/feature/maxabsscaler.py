@@ -41,7 +41,7 @@ class MaxAbsScalerModel(Model, MaxAbsScalerParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         scale = np.where(self.max_abs > 0, self.max_abs, 1.0)
         return [table.with_column(self.get_output_col(), X / scale[None, :])]
 
@@ -55,7 +55,7 @@ class MaxAbsScalerModel(Model, MaxAbsScalerParams):
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):
     def fit(self, *inputs: Table) -> MaxAbsScalerModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         max_abs = jax.jit(lambda a: jnp.max(jnp.abs(a), axis=0))(jnp.asarray(X))
         model = MaxAbsScalerModel()
         model.max_abs = np.asarray(max_abs, dtype=np.float64)

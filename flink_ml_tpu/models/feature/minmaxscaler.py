@@ -69,7 +69,7 @@ class MinMaxScalerModel(Model, MinMaxScalerParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         lo, hi = self.get_min(), self.get_max()
         span = self.max_vector - self.min_vector
         constant = np.abs(span) < 1.0e-5
@@ -96,7 +96,7 @@ def _column_min_max(X):
 class MinMaxScaler(Estimator, MinMaxScalerParams):
     def fit(self, *inputs: Table) -> MinMaxScalerModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         mn, mx = _column_min_max(jnp.asarray(X))
         model = MinMaxScalerModel()
         model.min_vector = np.asarray(mn, dtype=np.float64)

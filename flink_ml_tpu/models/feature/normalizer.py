@@ -38,6 +38,8 @@ def _normalize(X, p):
 class Normalizer(Transformer, NormalizerParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         out = _normalize(jnp.asarray(X), jnp.asarray(self.get_p()))
-        return [table.with_column(self.get_output_col(), np.asarray(out))]
+        if not isinstance(X, jax.Array):
+            out = np.asarray(out)
+        return [table.with_column(self.get_output_col(), out)]

@@ -50,8 +50,16 @@ def _expand_columns(X: np.ndarray, degree: int) -> np.ndarray:
 
     expand(size - 1, degree, np.ones(n_rows, dtype=X.dtype))
     # The first emitted column is the constant term, excluded by the
-    # reference (curPolyIdx starts at -1).
-    result = np.stack(out[1:], axis=1)
+    # reference (curPolyIdx starts at -1). Device inputs stack on device
+    # (np.stack over jax columns would silently pull every monomial D2H).
+    import jax
+
+    if isinstance(X, jax.Array):
+        import jax.numpy as jnp
+
+        result = jnp.stack(out[1:], axis=1)
+    else:
+        result = np.stack(out[1:], axis=1)
     assert result.shape[1] == comb(size + degree, degree) - 1
     return result
 
@@ -59,6 +67,6 @@ def _expand_columns(X: np.ndarray, degree: int) -> np.ndarray:
 class PolynomialExpansion(Transformer, PolynomialExpansionParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         out = _expand_columns(X, self.get_degree())
         return [table.with_column(self.get_output_col(), out)]

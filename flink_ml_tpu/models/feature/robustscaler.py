@@ -100,7 +100,7 @@ class RobustScalerModel(Model, RobustScalerModelParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         out = X
         if self.get_with_centering():
             out = out - self.medians[None, :]
@@ -130,7 +130,7 @@ class RobustScaler(Estimator, RobustScalerParams):
         if isinstance(table, StreamTable):
             med, lo, hi = self._fit_stream(table)
         else:
-            X = as_dense_matrix(table.column(self.get_input_col()))
+            X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
             qs = jnp.asarray([0.5, self.get_lower(), self.get_upper()])
             med, lo, hi = np.asarray(_quantiles(jnp.asarray(X), qs), dtype=np.float64)
         model = RobustScalerModel()

@@ -74,7 +74,7 @@ class StandardScalerModel(Model, StandardScalerParams):
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = np.asarray(as_dense_matrix(table.column(self.get_input_col())), dtype=np.float64)
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         out = X
         if self.get_with_mean():
             out = out - self.mean
@@ -94,7 +94,7 @@ class StandardScalerModel(Model, StandardScalerParams):
 class StandardScaler(Estimator, StandardScalerParams):
     def fit(self, *inputs: Table) -> StandardScalerModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         mean, std = _fit_stats(jnp.asarray(X))
         model = StandardScalerModel()
         model.mean = np.asarray(mean, dtype=np.float64)

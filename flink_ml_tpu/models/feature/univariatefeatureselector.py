@@ -136,7 +136,7 @@ class UnivariateFeatureSelectorModel(Model, UnivariateFeatureSelectorModelParams
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
         return [table.with_column(self.get_output_col(), X[:, self.indices])]
 
     def _save_extra(self, path: str) -> None:
@@ -153,7 +153,7 @@ class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
         label_type = self.get_label_type()
         if feature_type is None or label_type is None:
             raise ValueError("featureType and labelType must be set")
-        X = as_dense_matrix(table.column(self.get_features_col()))
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
         y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
         if feature_type == CATEGORICAL and label_type == CATEGORICAL:
             p_values, _, _ = stats.chi_square_test(X, y)

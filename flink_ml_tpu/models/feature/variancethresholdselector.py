@@ -56,7 +56,7 @@ class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams
 
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         if self.indices.size > 0 and self.indices.max() >= X.shape[1]:
             raise ValueError("Model feature count does not match input vector size")
         return [table.with_column(self.get_output_col(), X[:, self.indices])]
@@ -78,7 +78,7 @@ def _sample_variance(X):
 class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
     def fit(self, *inputs: Table) -> VarianceThresholdSelectorModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         var = np.asarray(_sample_variance(jnp.asarray(X)))
         model = VarianceThresholdSelectorModel()
         model.indices = np.nonzero(var > self.get_variance_threshold())[0]

@@ -71,7 +71,10 @@ class VectorIndexerModel(Model, VectorIndexerModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         handle = self.get_handle_invalid()
-        X = as_dense_matrix(table.column(self.get_input_col())).copy()
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
+        if not self.category_maps:  # nothing to re-index: pass through
+            return [table.with_column(self.get_output_col(), X)]
+        X = np.asarray(X, dtype=np.float64).copy()
         drop_mask = np.zeros(X.shape[0], dtype=bool)
         for col_id, mapping in self.category_maps.items():
             col = X[:, col_id]
@@ -117,13 +120,31 @@ class VectorIndexerModel(Model, VectorIndexerModelParams):
 class VectorIndexer(Estimator, VectorIndexerParams):
     def fit(self, *inputs: Table) -> VectorIndexerModel:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         max_cat = self.get_max_categories()
         category_maps = {}
-        for j in range(X.shape[1]):
-            distinct = np.unique(X[:, j])
-            if distinct.size <= max_cat:
-                category_maps[j] = _build_category_map(X[:, j])
+        import jax
+
+        if isinstance(X, jax.Array):
+            import jax.numpy as jnp
+
+            # count distinct per column on device (one sorted pass, one
+            # readback); only columns under the category limit — typically
+            # few or none for continuous data — pull their values to host
+            @jax.jit
+            def nunique(a):
+                S = jnp.sort(a, axis=0)
+                return 1 + jnp.sum(S[1:] != S[:-1], axis=0)
+
+            counts = np.asarray(nunique(X))
+            for j in range(X.shape[1]):
+                if counts[j] <= max_cat:
+                    category_maps[j] = _build_category_map(np.asarray(X[:, j]))
+        else:
+            for j in range(X.shape[1]):
+                distinct = np.unique(X[:, j])
+                if distinct.size <= max_cat:
+                    category_maps[j] = _build_category_map(X[:, j])
         model = VectorIndexerModel()
         model.category_maps = category_maps
         update_existing_params(model, self)

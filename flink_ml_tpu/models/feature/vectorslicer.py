@@ -50,7 +50,7 @@ class VectorSlicer(Transformer, VectorSlicerParams):
         indices = self.get_indices()
         if indices is None:
             raise ValueError("Parameter indices must be set")
-        X = as_dense_matrix(table.column(self.get_input_col()))
+        X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         idx = np.asarray(indices, dtype=np.int64)
         if idx.max() >= X.shape[1]:
             raise ValueError(

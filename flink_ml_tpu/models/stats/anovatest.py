@@ -25,7 +25,7 @@ class ANOVATestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
 class ANOVATest(AlgoOperator, ANOVATestParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
+        X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
         y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
         p_values, dofs, f_values = stats.anova_f_test(X, y)
         if self.get_flatten():

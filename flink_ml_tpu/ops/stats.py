@@ -65,22 +65,68 @@ def chi_square_test(
     return np.asarray(p_values), np.asarray(dofs, dtype=np.int64), np.asarray(stats)
 
 
+def _is_jax(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _anova_device_sums(X, y_idx, k):
+    """Per-class sums/counts/total-squares as MXU matmuls on device,
+    packed into one (k + 2, d + 1) array for a single readback."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def go(X, y_idx):
+        # center per feature first: the ANOVA decomposition is invariant
+        # under per-feature shifts, and centering keeps the float32
+        # sums-of-squares differences from catastrophically cancelling
+        # when |mean| >> within-class std
+        Xc = X - jnp.mean(X, axis=0, keepdims=True)
+        onehot = jax.nn.one_hot(y_idx, k, dtype=X.dtype)  # (n, k)
+        sums = onehot.T @ Xc  # (k, d)
+        counts = jnp.sum(onehot, axis=0)  # (k,)
+        total_sq = jnp.sum(Xc * Xc, axis=0)  # (d,)
+        top = jnp.concatenate([sums, counts[:, None]], axis=1)
+        bottom = jnp.concatenate([total_sq[None, :], jnp.zeros((1, 1), X.dtype)], axis=1)
+        pad = jnp.zeros((1, X.shape[1] + 1), X.dtype)
+        return jnp.concatenate([top, bottom, pad], axis=0)
+
+    packed = np.asarray(go(X, jnp.asarray(y_idx))).astype(np.float64)
+    sums = packed[:k, :-1]
+    counts = packed[:k, -1]
+    total_sq = packed[k, :-1]
+    return sums, counts, total_sq
+
+
 def anova_f_test(
     X: np.ndarray, y: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """One-way ANOVA F-test of each continuous feature against a categorical
     label. Returns (p_values, dofs, f_statistics) with the reference's
-    reported dof = (k - 1) + (n - k) = n - 1 (ANOVATest.java:232)."""
-    X = np.asarray(X, dtype=np.float64)
+    reported dof = (k - 1) + (n - k) = n - 1 (ANOVATest.java:232).
+
+    Device-resident X stays on device: the per-class aggregation is a
+    one-hot MXU matmul with a single small readback (pulling a 10M x 100
+    benchmark table to the single-core host costs minutes)."""
     y = np.asarray(y)
-    n, d = X.shape
     y_cats, y_idx = np.unique(y, return_inverse=True)
     k = len(y_cats)
-    y_onehot = np.eye(k)[y_idx]
-    counts = y_onehot.sum(axis=0)  # (k,)
-    sums = y_onehot.T @ X  # (k, d)
+    if _is_jax(X):
+        n, d = X.shape
+        sums, counts, total_sq = _anova_device_sums(X, y_idx, k)
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        n, d = X.shape
+        y_onehot = np.eye(k)[y_idx]
+        counts = y_onehot.sum(axis=0)  # (k,)
+        sums = y_onehot.T @ X  # (k, d)
+        total_sq = (X * X).sum(axis=0)
     total_sum = sums.sum(axis=0)
-    total_sq = (X * X).sum(axis=0)
     ss_tot = total_sq - total_sum**2 / n
     ss_between = (sums**2 / counts[:, None]).sum(axis=0) - total_sum**2 / n
     ss_within = ss_tot - ss_between
@@ -98,13 +144,35 @@ def f_value_test(
     """Univariate linear-regression F-test of each continuous feature against
     a continuous label (FValueTest.java). Returns (p_values, dofs, f_stats)
     with dof = n - 2."""
-    X = np.asarray(X, dtype=np.float64)
     y = np.asarray(y, dtype=np.float64)
-    n, d = X.shape
-    xm = X.mean(axis=0)
-    ym = y.mean()
-    num = ((X - xm) * (y - ym)[:, None]).sum(axis=0)
-    den = np.sqrt(((X - xm) ** 2).sum(axis=0) * ((y - ym) ** 2).sum())
+    if _is_jax(X):
+        import jax
+        import jax.numpy as jnp
+
+        n, d = X.shape
+
+        @jax.jit
+        def centered_moments(X, y):
+            # center both sides in-program: the naive sum_x2 - n*xm^2 form
+            # catastrophically cancels in float32 when |mean| >> std. Packs
+            # [sum (x-xm)^2, sum (x-xm)(y-ym)] for one readback.
+            Xc = X - jnp.mean(X, axis=0, keepdims=True)
+            yc = y - jnp.mean(y)
+            return jnp.stack([jnp.sum(Xc * Xc, axis=0), Xc.T @ yc])
+
+        m = np.asarray(
+            centered_moments(X, jnp.asarray(y, X.dtype))
+        ).astype(np.float64)
+        ss_x, num = m
+        ym = y.mean()
+        den = np.sqrt(ss_x * ((y - ym) ** 2).sum())
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        n, d = X.shape
+        xm = X.mean(axis=0)
+        ym = y.mean()
+        num = ((X - xm) * (y - ym)[:, None]).sum(axis=0)
+        den = np.sqrt(((X - xm) ** 2).sum(axis=0) * ((y - ym) ** 2).sum())
     with np.errstate(divide="ignore", invalid="ignore"):
         corr = np.where(den > 0, num / den, 0.0)
     dfd = n - 2

@@ -121,6 +121,18 @@ def main(argv) -> None:
             results[f"{base}:?"] = {"error": f"timeout after {wall:.0f}s"}
             print(f"{base:60s} TIMEOUT after {wall:.0f}s", flush=True)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    if only and os.path.exists(out_path):
+        # partial (named-config) runs MERGE into the existing sweep file
+        # instead of clobbering the other 30+ entries
+        try:
+            with open(out_path) as f:
+                previous = json.load(f).get("entries", {})
+            stale_prefixes = {os.path.basename(p) + ":" for p in paths}
+            for key, rec in previous.items():
+                if not any(key.startswith(pre) for pre in stale_prefixes):
+                    results.setdefault(key, rec)
+        except (OSError, ValueError):
+            pass
     meta = {
         "timeoutS": timeout,
         "runsPerEntry": runs,
