@@ -127,7 +127,7 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
                 valid = (jnp.asarray(col.ids) >= 0).sum(axis=1)
                 thr = (min_tf * valid).astype(jnp.float32)
             indices, values = tokens_ops.map_term_runs_chunked(
-                col.ids, lut, thr, binary=binary
+                col.ids, lut, thr, binary=binary, num_terms=size
             )
             return [
                 table.with_column(

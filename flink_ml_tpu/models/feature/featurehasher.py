@@ -43,10 +43,10 @@ class FeatureHasher(Transformer, FeatureHasherParams):
         categorical = set(self.get_categorical_cols())
         if not categorical.issubset(input_cols):
             raise ValueError("CategoricalCols must be included in inputCols!")
+        host_cols = {c: np.asarray(table.column(c)) for c in input_cols}
         # string/boolean columns are categorical even when not declared
         # (FeatureHasher.generateCategoricalCols)
-        for col in input_cols:
-            values = np.asarray(table.column(col))
+        for col, values in host_cols.items():
             if values.dtype == object or values.dtype.kind in "USb":
                 categorical.add(col)
         n_features = self.get_num_features()
@@ -58,7 +58,6 @@ class FeatureHasher(Transformer, FeatureHasherParams):
                 return "true" if v else "false"
             return str(v)
 
-        host_cols = {c: np.asarray(table.column(c)) for c in input_cols}
         vectorizable = all(
             arr.ndim == 1 and arr.dtype.kind in "fiub" for arr in host_cols.values()
         )

@@ -55,7 +55,7 @@ class HashingTF(Transformer, HashingTFParams):
             )
             thr = jnp.ones((col.n,), jnp.float32)
             indices, values = tokens_ops.map_term_runs_chunked(
-                col.ids, lut, thr, binary=binary
+                col.ids, lut, thr, binary=binary, num_terms=n_features
             )
             return [
                 table.with_column(

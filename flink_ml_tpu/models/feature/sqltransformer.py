@@ -113,9 +113,11 @@ class SQLTransformer(Transformer):
 # LN/LOG10/SIN/COS on whole columns (numpy or device arrays: the operators
 # dispatch to the column's own array type). Anything else falls back to
 # the sqlite path. This also covers expressions over VECTOR columns, which
-# sqlite cannot represent (VERDICT r3 weak #6). Known divergence: float
-# division by zero yields inf/nan here where sqlite yields NULL; integer
-# columns bail to sqlite so its integer-division semantics are preserved.
+# sqlite cannot represent (VERDICT r3 weak #6). Known divergences from
+# sqlite (all NULL there): float column division by zero yields inf/nan,
+# and out-of-domain SQRT/LN/LOG10 yield nan/-inf (IEEE semantics, which
+# the reference's Flink SQL also uses for DOUBLE). Integer columns bail
+# to sqlite so its integer-division semantics are preserved.
 
 _FUNCS = frozenset({"abs", "sqrt", "exp", "ln", "log10", "sin", "cos"})
 
@@ -276,7 +278,7 @@ def _try_vectorized_projection(statement: str, table: Table):
             continue
         try:
             value = _ExprParser(_tokenize(expr), table).parse()
-        except (ValueError, KeyError, IndexError):
+        except (ValueError, KeyError, IndexError, TypeError, ZeroDivisionError):
             return None
         if np.ndim(value) == 0:  # constant: broadcast to column
             value = np.full(table.num_rows, float(value))

@@ -489,3 +489,13 @@ def test_sqltransformer_string_column_falls_back():
         "SELECT name, v FROM __THIS__"
     ).transform(t)[0]
     assert out2.num_rows == 2
+
+
+def test_sqltransformer_div_by_zero_falls_back_to_sqlite():
+    from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
+
+    t = Table({"v1": np.array([1.0, 2.0])})
+    out = SQLTransformer().set_statement(
+        "SELECT v1, 1/0 AS x FROM __THIS__"
+    ).transform(t)[0]
+    assert out.num_rows == 2  # sqlite path: x is NULL, no crash
