@@ -184,7 +184,11 @@ def map_term_runs_chunked(
     (`num_terms` <= DENSE_COUNT_MAX_TERMS) use the gather-free dense-count
     kernel (~5x the sort-run kernel on TPU)."""
     n, k = ids.shape
-    dense = num_terms is not None and num_terms <= DENSE_COUNT_MAX_TERMS
+    dense = (
+        num_terms is not None
+        and num_terms <= DENSE_COUNT_MAX_TERMS
+        and (k + 1) * int(num_terms) < 2**31  # packed (term, count) fits int32
+    )
 
     def run_chunk(chunk_ids, chunk_thr):
         if dense:
@@ -214,10 +218,19 @@ def gather_map(ids, lut):
 @jax.jit
 def filter_tokens(ids, keep_vocab):
     """Drop tokens whose vocab id is masked out, compacting survivors left
-    and padding with -1 — order preserved (StopWordsRemover semantics)."""
+    and padding with -1 — order preserved (StopWordsRemover semantics).
+    Gather-free: (position, id) pairs pack into one int32 (kept entries
+    position-major, dropped entries pushed to the max), so a single row
+    sort does the compaction and the decode is elementwise."""
     n, k = ids.shape
+    V = keep_vocab.shape[0]
     idxs = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (n, k))
     keep = (ids >= 0) & keep_vocab[jnp.where(ids >= 0, ids, 0)]
+    if k * V < 2**31:  # packed path: one sort, no argsort/gather
+        big = jnp.int32(2**31 - 1)
+        packed = jnp.where(keep, idxs * V + ids, big)
+        S = jnp.sort(packed, axis=1)
+        return jnp.where(S != big, S % V, -1)
     order = jnp.argsort(jnp.where(keep, idxs, k), axis=1, stable=True)
     return jnp.take_along_axis(jnp.where(keep, ids, -1), order, axis=1)
 

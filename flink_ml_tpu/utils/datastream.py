@@ -21,7 +21,14 @@ from ..table import StreamTable, Table
 A = TypeVar("A")
 R = TypeVar("R")
 
-__all__ = ["aggregate", "sample", "iter_batches"]
+__all__ = [
+    "aggregate",
+    "map_partition",
+    "reduce",
+    "sample",
+    "window_all_and_process",
+    "iter_batches",
+]
 
 
 def iter_batches(data: Union[Table, StreamTable]) -> Iterable[Table]:
@@ -98,3 +105,105 @@ def sample(
     if reservoir is None:
         raise ValueError("cannot sample from an empty stream")
     return reservoir
+
+
+def map_partition(
+    data: Union[Table, StreamTable], fn: Callable[[Table], Table]
+) -> Union[Table, StreamTable]:
+    """Apply a whole-partition function to each bounded batch
+    (DataStreamUtils.mapPartition, :115). The reference hands the operator
+    an iterator over its partition's rows; the columnar analogue hands `fn`
+    a whole mini-batch Table and keeps the stream shape: a bounded Table
+    maps to a Table, a StreamTable maps lazily batch-by-batch."""
+    if isinstance(data, Table):
+        return fn(data)
+    return StreamTable(fn(batch) for batch in data)
+
+
+def reduce(
+    data: Union[Table, StreamTable], fn: Callable[[Table, Table], Table]
+) -> Table:
+    """Pairwise-fold every batch into one Table
+    (DataStreamUtils.reduce, :132)."""
+    acc = None
+    for batch in iter_batches(data):
+        acc = batch if acc is None else fn(acc, batch)
+    if acc is None:
+        raise ValueError("reduce over an empty stream")
+    return acc
+
+
+def window_all_and_process(
+    data: Union[Table, StreamTable],
+    windows,
+    fn: Callable[[Table], Table],
+) -> Union[Table, StreamTable]:
+    """Re-chunk the input by a window descriptor and apply `fn` per window
+    (DataStreamUtils.windowAllAndProcess, :262 — the mechanism behind
+    windowed local processing like AgglomerativeClustering's per-window
+    clustering).
+
+    GlobalWindows = one window over the whole bounded input (or each
+    incoming batch of an unbounded stream, the endOfStreamWindows
+    behaviour); CountTumblingWindows(k) = windows of exactly k rows —
+    Flink count windows only fire when FULL, so the ragged tail is
+    dropped. Time windows need the online runtime's timestamp handling
+    and are rejected here."""
+    from ..common.window import CountTumblingWindows, GlobalWindows
+
+    if isinstance(windows, GlobalWindows):
+        # ONE window over the whole bounded input (endOfStreamWindows):
+        # a stream materializes first so Table and StreamTable layouts of
+        # the same data give identical results
+        batches = list(iter_batches(data))
+        if not batches:
+            return Table({})
+        whole = batches[0]
+        for b in batches[1:]:
+            whole = whole.concat(b)
+        result = fn(whole)
+        return StreamTable([result]) if isinstance(data, StreamTable) else result
+    if isinstance(windows, CountTumblingWindows):
+        size = int(windows.size)
+
+        def chunks() -> Iterable[Table]:
+            # accumulate whole batches and concat once per emitted window —
+            # re-concatenating the pending buffer per batch would be
+            # quadratic when batches are much smaller than the window
+            pending: List[Table] = []
+            pending_rows = 0
+            for batch in iter_batches(data):
+                pending.append(batch)
+                pending_rows += batch.num_rows
+                while pending_rows >= size:
+                    merged = pending[0]
+                    for b in pending[1:]:
+                        merged = merged.concat(b)
+                    off = 0
+                    while merged.num_rows - off >= size:
+                        yield merged.take(np.arange(off, off + size))
+                        off += size
+                    pending = (
+                        [merged.take(np.arange(off, merged.num_rows))]
+                        if off < merged.num_rows
+                        else []
+                    )
+                    pending_rows = merged.num_rows - off
+            # ragged tail dropped: count windows fire only when full
+
+        if isinstance(data, Table):
+            results = [fn(w) for w in chunks()]
+            if not results:
+                # no full window ever fires — the reference emits an empty
+                # (typed) stream; without static typing the closest analogue
+                # is a column-less empty Table
+                return Table({})
+            out = results[0]
+            for r in results[1:]:
+                out = out.concat(r)
+            return out
+        return StreamTable(fn(w) for w in chunks())
+    raise NotImplementedError(
+        f"{type(windows).__name__} needs event-/processing-time semantics; "
+        "use the online iteration runtime for time windows"
+    )

@@ -121,3 +121,51 @@ class TestFunctions:
         X = jnp.ones((4, 3))
         assert vector_to_array(X) is X
         assert array_to_vector(X) is X
+
+
+class TestDataStreamUtils:
+    def test_map_partition_table_and_stream(self):
+        from flink_ml_tpu import StreamTable
+        from flink_ml_tpu.utils.datastream import map_partition
+
+        double = lambda t: t.with_column("x", np.asarray(t.column("x")) * 2)
+        t = Table({"x": [1.0, 2.0]})
+        np.testing.assert_array_equal(
+            np.asarray(map_partition(t, double).column("x")), [2.0, 4.0]
+        )
+        out = list(map_partition(StreamTable.from_batches([t, t]), double))
+        assert len(out) == 2
+        np.testing.assert_array_equal(np.asarray(out[1].column("x")), [2.0, 4.0])
+
+    def test_reduce(self):
+        from flink_ml_tpu import StreamTable
+        from flink_ml_tpu.utils.datastream import reduce
+
+        batches = [Table({"x": [float(i)]}) for i in range(4)]
+        out = reduce(StreamTable.from_batches(batches), lambda a, b: a.concat(b))
+        assert out.num_rows == 4
+
+    def test_window_all_and_process_count_tumbling(self):
+        from flink_ml_tpu import StreamTable
+        from flink_ml_tpu.common.window import CountTumblingWindows, GlobalWindows
+        from flink_ml_tpu.utils.datastream import window_all_and_process
+
+        count_rows = lambda t: Table({"n": [float(t.num_rows)]})
+        t = Table({"x": np.arange(10.0)})
+        out = window_all_and_process(t, CountTumblingWindows.of(4), count_rows)
+        np.testing.assert_array_equal(np.asarray(out.column("n")), [4.0, 4.0])
+        # windows span batch boundaries; tail dropped
+        stream = StreamTable.from_batches(
+            [Table({"x": np.arange(3.0)}), Table({"x": np.arange(7.0)})]
+        )
+        out2 = list(window_all_and_process(stream, CountTumblingWindows.of(4), count_rows))
+        assert len(out2) == 2
+        g = window_all_and_process(t, GlobalWindows(), count_rows)
+        np.testing.assert_array_equal(np.asarray(g.column("n")), [10.0])
+        # GlobalWindows over a stream = ONE window over the whole input
+        stream2 = StreamTable.from_batches(
+            [Table({"x": np.arange(3.0)}), Table({"x": np.arange(7.0)})]
+        )
+        gs = list(window_all_and_process(stream2, GlobalWindows(), count_rows))
+        assert len(gs) == 1
+        np.testing.assert_array_equal(np.asarray(gs[0].column("n")), [10.0])
