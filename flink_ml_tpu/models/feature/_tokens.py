@@ -115,11 +115,12 @@ def ragged_from_mask(A: np.ndarray, keep: np.ndarray) -> np.ndarray:
 def map_rows_by_unique(col: np.ndarray, fn) -> np.ndarray:
     """Apply `fn(str) -> object` to a string column through its dictionary:
     fn runs once per DISTINCT value, results are gathered back by id. Rows
-    with equal strings share the resulting object (treat as read-only)."""
-    uniq, inv = np.unique(col, return_inverse=True)
+    with equal strings share the resulting object (treat as read-only).
+    Uses `encode`'s raw-bit unique fast path when the dtype allows."""
+    uniq, ids = encode(col.reshape(-1, 1))
     results = np.empty(len(uniq), dtype=object)
     results[:] = [fn(str(u)) for u in uniq]
-    return results[inv.reshape(-1)]
+    return results[ids.reshape(-1)]
 
 
 def lookup(uniq: np.ndarray, mapping, default: int = -1) -> np.ndarray:

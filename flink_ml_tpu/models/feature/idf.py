@@ -39,6 +39,17 @@ class IDFParams(IDFModelParams):
         return self.set(self.MIN_DOC_FREQ, value)
 
 
+def _count_nonzero_impl(a):
+    import jax.numpy as jnp
+
+    return jnp.sum(a != 0, axis=0)
+
+
+from ...utils.lazyjit import lazy_jit  # noqa: E402
+
+_count_nonzero_per_col = lazy_jit(_count_nonzero_impl)
+
+
 class IDFModel(Model, IDFModelParams):
     def __init__(self):
         self.idf: np.ndarray = None
@@ -105,11 +116,7 @@ class IDF(Estimator, IDFParams):
             import jax
 
             if isinstance(X, jax.Array):
-                import jax.numpy as jnp
-
-                df = np.asarray(
-                    jax.jit(lambda a: jnp.sum(a != 0, axis=0))(X), dtype=np.float64
-                )
+                df = np.asarray(_count_nonzero_per_col(X), dtype=np.float64)
             else:
                 df = (X != 0).sum(axis=0).astype(np.float64)
             n_docs = X.shape[0]

@@ -113,19 +113,7 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
             for j, e in enumerate(self.bin_edges):
                 edges_mat[j, : e.size] = e
                 nbins[j] = max(e.size - 2, 0)
-
-            @jax.jit
-            def bin_all(X, edges_mat, nbins):
-                def one(col, edges, nb):
-                    idx = jnp.searchsorted(edges, col, side="right") - 1
-                    idx = jnp.clip(idx, 0, jnp.maximum(nb, 0))
-                    return jnp.where(nb > 0, idx, 0).astype(col.dtype)
-
-                return jax.vmap(one, in_axes=(1, 0, 0), out_axes=1)(
-                    X, edges_mat, nbins
-                )
-
-            out = bin_all(X, jnp.asarray(edges_mat, X.dtype), jnp.asarray(nbins))
+            out = _bin_all(X, jnp.asarray(edges_mat, X.dtype), jnp.asarray(nbins))
             return [table.with_column(self.get_output_col(), out)]
         X = np.asarray(X, dtype=np.float64).copy()
         for j, edges in enumerate(self.bin_edges):
@@ -147,6 +135,29 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
         self.bin_edges = [np.asarray(e, dtype=np.float64) for e in arrays["binEdges"]]
 
 
+@jax.jit
+def _col_quantiles(a, qs):
+    return jnp.quantile(a, qs, axis=0)
+
+
+@jax.jit
+def _col_min_max(a):
+    return jnp.stack([jnp.min(a, axis=0), jnp.max(a, axis=0)])
+
+
+@jax.jit
+def _bin_all(X, edges_mat, nbins):
+    """vmapped per-column searchsorted binning (module-level jit: an
+    inline jit would recompile on every transform)."""
+
+    def one(col, edges, nb):
+        idx = jnp.searchsorted(edges, col, side="right") - 1
+        idx = jnp.clip(idx, 0, jnp.maximum(nb, 0))
+        return jnp.where(nb > 0, idx, 0).astype(col.dtype)
+
+    return jax.vmap(one, in_axes=(1, 0, 0), out_axes=1)(X, edges_mat, nbins)
+
+
 class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
     def fit(self, *inputs: Table) -> KBinsDiscretizerModel:
         (table,) = inputs
@@ -166,12 +177,7 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         # per-column edge cleanup (tiny) runs on host
         if strategy == UNIFORM:
             if isinstance(X, jax.Array):
-                lo_hi = np.asarray(
-                    jax.jit(
-                        lambda a: jnp.stack([jnp.min(a, axis=0), jnp.max(a, axis=0)])
-                    )(X),
-                    dtype=np.float64,
-                )
+                lo_hi = np.asarray(_col_min_max(X), dtype=np.float64)
             else:  # host float64 stays float64 (device cast would round)
                 lo_hi = np.stack([np.min(X, axis=0), np.max(X, axis=0)]).astype(
                     np.float64
@@ -186,9 +192,7 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
             qs = np.linspace(0.0, 1.0, num_bins + 1)
             if isinstance(X, jax.Array):
                 all_edges = np.asarray(
-                    jax.jit(jnp.quantile, static_argnames=("axis",))(
-                        X, jnp.asarray(qs, X.dtype), axis=0
-                    ),
+                    _col_quantiles(X, jnp.asarray(qs, X.dtype)),
                     dtype=np.float64,
                 )  # (num_bins + 1, d)
             else:

@@ -137,7 +137,11 @@ class UnivariateFeatureSelectorModel(Model, UnivariateFeatureSelectorModelParams
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        return [table.with_column(self.get_output_col(), X[:, self.indices])]
+        from ...ops.selection import select_columns
+
+        return [
+            table.with_column(self.get_output_col(), select_columns(X, self.indices))
+        ]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, indices=self.indices)

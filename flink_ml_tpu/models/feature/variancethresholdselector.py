@@ -59,7 +59,11 @@ class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         if self.indices.size > 0 and self.indices.max() >= X.shape[1]:
             raise ValueError("Model feature count does not match input vector size")
-        return [table.with_column(self.get_output_col(), X[:, self.indices])]
+        from ...ops.selection import select_columns
+
+        return [
+            table.with_column(self.get_output_col(), select_columns(X, self.indices))
+        ]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, indices=self.indices)

@@ -117,6 +117,18 @@ class VectorIndexerModel(Model, VectorIndexerModelParams):
         }
 
 
+def _nunique_impl(a):
+    import jax.numpy as jnp
+
+    S = jnp.sort(a, axis=0)
+    return 1 + jnp.sum(S[1:] != S[:-1], axis=0)
+
+
+from ...utils.lazyjit import lazy_jit  # noqa: E402
+
+_nunique_per_column = lazy_jit(_nunique_impl)
+
+
 class VectorIndexer(Estimator, VectorIndexerParams):
     def fit(self, *inputs: Table) -> VectorIndexerModel:
         (table,) = inputs
@@ -126,17 +138,10 @@ class VectorIndexer(Estimator, VectorIndexerParams):
         import jax
 
         if isinstance(X, jax.Array):
-            import jax.numpy as jnp
-
             # count distinct per column on device (one sorted pass, one
             # readback); only columns under the category limit — typically
             # few or none for continuous data — pull their values to host
-            @jax.jit
-            def nunique(a):
-                S = jnp.sort(a, axis=0)
-                return 1 + jnp.sum(S[1:] != S[:-1], axis=0)
-
-            counts = np.asarray(nunique(X))
+            counts = np.asarray(_nunique_per_column(X))
             for j in range(X.shape[1]):
                 if counts[j] <= max_cat:
                     category_maps[j] = _build_category_map(np.asarray(X[:, j]))

@@ -26,7 +26,14 @@ class ANOVATest(AlgoOperator, ANOVATestParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        y_col = table.column(self.get_label_col())
+        import jax
+
+        y = (
+            y_col
+            if isinstance(y_col, jax.Array)  # stats kernels keep labels on device
+            else np.asarray(y_col, dtype=np.float64)
+        )
         p_values, dofs, f_values = stats.anova_f_test(X, y)
         if self.get_flatten():
             return [

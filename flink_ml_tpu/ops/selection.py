@@ -1,0 +1,30 @@
+"""Exact device-side column selection.
+
+`X[:, indices]` compiles to a gather, which is seconds at (10M, 100) on
+TPU; a 0/1 selection matmul rides the MXU instead. Precision.HIGHEST is
+required: the default TPU matmul passes operands through bfloat16, which
+would silently round the selected values (~0.4%% relative) — with the
+3-pass HIGHEST decomposition a permutation matmul reproduces float32
+inputs exactly (verified by test_feature_estimators exactness test).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _select_matmul(a, s):
+    return jnp.matmul(a, s, precision=jax.lax.Precision.HIGHEST)
+
+
+def select_columns(X, indices):
+    """Columns `indices` of X, in order — exact on host and device."""
+    idx = np.asarray(indices)
+    if not isinstance(X, jax.Array) or idx.size == 0:
+        return X[:, idx]
+    S = np.zeros((X.shape[1], idx.size), np.float32)
+    S[idx, np.arange(idx.size)] = 1.0
+    return _select_matmul(X, jnp.asarray(S, X.dtype))

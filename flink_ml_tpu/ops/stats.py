@@ -74,19 +74,46 @@ def _is_jax(x) -> bool:
         return False
 
 
-def _anova_device_sums(X, y_idx, k):
-    """Per-class sums/counts/total-squares as MXU matmuls on device,
-    packed into one (k + 2, d + 1) array for a single readback."""
+from ..utils.lazyjit import keyed_jit, lazy_jit
+
+
+def _nunique_impl(y):
+    import jax.numpy as jnp
+
+    s = jnp.sort(y)
+    return 1 + jnp.sum(s[1:] != s[:-1])
+
+
+_nunique_device = lazy_jit(_nunique_impl)
+
+
+def _make_unique_kernel(k):
+    import jax.numpy as jnp
+
+    return lambda y: jnp.unique(y, size=k)
+
+
+_unique_kernel = keyed_jit(_make_unique_kernel)
+
+
+def _unique_device(y, k):
+    return _unique_kernel(k)(y)
+
+
+def _make_anova_kernel(k):
+    """Kernel per class count k (keyed_jit caches the compiled wrapper —
+    a jit created inside the call would RECOMPILE on every fit, which on
+    the remote-compile tunnel costs seconds per call)."""
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def go(X, y_idx):
+    def go(X, y, classes):
         # center per feature first: the ANOVA decomposition is invariant
         # under per-feature shifts, and centering keeps the float32
         # sums-of-squares differences from catastrophically cancelling
         # when |mean| >> within-class std
         Xc = X - jnp.mean(X, axis=0, keepdims=True)
+        y_idx = jnp.searchsorted(classes, y)
         onehot = jax.nn.one_hot(y_idx, k, dtype=X.dtype)  # (n, k)
         sums = onehot.T @ Xc  # (k, d)
         counts = jnp.sum(onehot, axis=0)  # (k,)
@@ -96,7 +123,19 @@ def _anova_device_sums(X, y_idx, k):
         pad = jnp.zeros((1, X.shape[1] + 1), X.dtype)
         return jnp.concatenate([top, bottom, pad], axis=0)
 
-    packed = np.asarray(go(X, jnp.asarray(y_idx))).astype(np.float64)
+    return go
+
+
+_anova_sums_kernel = keyed_jit(_make_anova_kernel)
+
+
+def _anova_device_sums(X, y_dev, classes, k):
+    """Per-class sums/counts/total-squares as MXU matmuls on device,
+    packed into one (k + 2, d + 1) array for a single readback."""
+    import jax.numpy as jnp
+
+    go = _anova_sums_kernel(k)
+    packed = np.asarray(go(X, jnp.asarray(y_dev, X.dtype), classes)).astype(np.float64)
     sums = packed[:k, :-1]
     counts = packed[:k, -1]
     total_sq = packed[k, :-1]
@@ -113,13 +152,21 @@ def anova_f_test(
     Device-resident X stays on device: the per-class aggregation is a
     one-hot MXU matmul with a single small readback (pulling a 10M x 100
     benchmark table to the single-core host costs minutes)."""
-    y = np.asarray(y)
-    y_cats, y_idx = np.unique(y, return_inverse=True)
-    k = len(y_cats)
     if _is_jax(X):
+        # keep y on device too: pulling a 10M-row label column costs ~3.4s
+        # over the tunnel; class discovery reads back only the (k,) class
+        # values and the kernel maps labels by searchsorted in-program
+        import jax.numpy as jnp
+
+        y_dev = y if _is_jax(y) else jnp.asarray(np.asarray(y))
         n, d = X.shape
-        sums, counts, total_sq = _anova_device_sums(X, y_idx, k)
+        k = int(np.asarray(_nunique_device(y_dev)))
+        classes = _unique_device(y_dev, k)
+        sums, counts, total_sq = _anova_device_sums(X, y_dev, classes, k)
     else:
+        y = np.asarray(y)
+        y_cats, y_idx = np.unique(y, return_inverse=True)
+        k = len(y_cats)
         X = np.asarray(X, dtype=np.float64)
         n, d = X.shape
         y_onehot = np.eye(k)[y_idx]
@@ -138,35 +185,45 @@ def anova_f_test(
     return p, np.full(d, dfn + dfd, dtype=np.int64), f_stat
 
 
+def _centered_moments_impl(X, y):
+    # center both sides in-program: the naive sum_x2 - n*xm^2 form
+    # catastrophically cancels in float32 when |mean| >> std. Packs
+    # rows [sum (x-xm)^2 ..., sum (y-ym)^2] and [sum (x-xm)(y-ym) ..., 0]
+    # for one readback (y stays on device — no 40MB label pull).
+    import jax.numpy as jnp
+
+    Xc = X - jnp.mean(X, axis=0, keepdims=True)
+    yc = y - jnp.mean(y)
+    ss_y = jnp.sum(yc * yc)
+    row0 = jnp.concatenate([jnp.sum(Xc * Xc, axis=0), ss_y[None]])
+    row1 = jnp.concatenate([Xc.T @ yc, jnp.zeros((1,), X.dtype)])
+    return jnp.stack([row0, row1])
+
+
+_centered_moments = lazy_jit(_centered_moments_impl)
+
+
 def f_value_test(
     X: np.ndarray, y: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Univariate linear-regression F-test of each continuous feature against
     a continuous label (FValueTest.java). Returns (p_values, dofs, f_stats)
     with dof = n - 2."""
-    y = np.asarray(y, dtype=np.float64)
     if _is_jax(X):
-        import jax
         import jax.numpy as jnp
 
+        y_dev = (
+            y
+            if _is_jax(y) and y.dtype == X.dtype
+            else jnp.asarray(np.asarray(y) if not _is_jax(y) else y, X.dtype)
+        )
         n, d = X.shape
-
-        @jax.jit
-        def centered_moments(X, y):
-            # center both sides in-program: the naive sum_x2 - n*xm^2 form
-            # catastrophically cancels in float32 when |mean| >> std. Packs
-            # [sum (x-xm)^2, sum (x-xm)(y-ym)] for one readback.
-            Xc = X - jnp.mean(X, axis=0, keepdims=True)
-            yc = y - jnp.mean(y)
-            return jnp.stack([jnp.sum(Xc * Xc, axis=0), Xc.T @ yc])
-
-        m = np.asarray(
-            centered_moments(X, jnp.asarray(y, X.dtype))
-        ).astype(np.float64)
-        ss_x, num = m
-        ym = y.mean()
-        den = np.sqrt(ss_x * ((y - ym) ** 2).sum())
+        m = np.asarray(_centered_moments(X, y_dev)).astype(np.float64)
+        ss_x, num = m[0][:-1], m[1][:-1]
+        ss_y = m[0][-1]
+        den = np.sqrt(ss_x * ss_y)
     else:
+        y = np.asarray(y, dtype=np.float64)
         X = np.asarray(X, dtype=np.float64)
         n, d = X.shape
         xm = X.mean(axis=0)

@@ -143,21 +143,23 @@ def window_all_and_process(
     windowed local processing like AgglomerativeClustering's per-window
     clustering).
 
-    GlobalWindows = one window over the whole bounded input (or each
-    incoming batch of an unbounded stream, the endOfStreamWindows
-    behaviour); CountTumblingWindows(k) = windows of exactly k rows —
+    GlobalWindows = one window over the whole bounded input (the
+    endOfStreamWindows behaviour — a StreamTable is materialized, so pass
+    bounded streams only); CountTumblingWindows(k) = windows of exactly k rows —
     Flink count windows only fire when FULL, so the ragged tail is
     dropped. Time windows need the online runtime's timestamp handling
     and are rejected here."""
     from ..common.window import CountTumblingWindows, GlobalWindows
 
     if isinstance(windows, GlobalWindows):
-        # ONE window over the whole bounded input (endOfStreamWindows):
+        # ONE window over the whole BOUNDED input (endOfStreamWindows):
         # a stream materializes first so Table and StreamTable layouts of
-        # the same data give identical results
+        # the same data give identical results. This helper is for bounded
+        # inputs only — unbounded per-batch processing lives in the online
+        # iteration runtime, not here.
         batches = list(iter_batches(data))
         if not batches:
-            return Table({})
+            return StreamTable([]) if isinstance(data, StreamTable) else Table({})
         whole = batches[0]
         for b in batches[1:]:
             whole = whole.concat(b)

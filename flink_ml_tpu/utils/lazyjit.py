@@ -1,0 +1,44 @@
+"""Lazily-compiled module-level jit kernels.
+
+jax.jit called inside a function body creates a NEW wrapper per call, so
+every call recompiles (seconds each over this environment's remote-compile
+tunnel). These helpers give the two needed shapes — a singleton kernel and
+a kernel family keyed by a static value — as one-liners, replacing the
+hand-rolled `global _X_JIT` caches that were spreading per module.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+
+def lazy_jit(fn: Callable, **jit_kwargs) -> Callable:
+    """A callable that jits `fn` on first use and reuses the wrapper."""
+    box = []
+
+    def call(*args, **kwargs):
+        if not box:
+            import jax
+
+            box.append(jax.jit(fn, **jit_kwargs))
+        return box[0](*args, **kwargs)
+
+    call.__name__ = getattr(fn, "__name__", "lazy_jit")
+    return call
+
+
+def keyed_jit(make_fn: Callable, **jit_kwargs) -> Callable:
+    """A factory cache: `keyed_jit(make)(key)` jits `make(key)` once per
+    distinct key (for kernels whose body depends on a static value)."""
+    cache: Dict[Tuple, Callable] = {}
+
+    def get(*key):
+        fn = cache.get(key)
+        if fn is None:
+            import jax
+
+            fn = jax.jit(make_fn(*key), **jit_kwargs)
+            cache[key] = fn
+        return fn
+
+    return get

@@ -550,3 +550,19 @@ class TestSQLTransformer:
     def test_requires_this(self):
         with pytest.raises(ValueError):
             SQLTransformer().set_statement("SELECT 1")
+
+
+def test_select_columns_exact_on_device():
+    """MXU selection must reproduce float32 values bit-exactly (default
+    matmul precision would round through bfloat16)."""
+    import jax.numpy as jnp
+
+    from flink_ml_tpu.ops.selection import select_columns
+
+    rng = np.random.default_rng(0)
+    X_host = rng.random((257, 9)).astype(np.float32) + 0.333333
+    X_dev = jnp.asarray(X_host)
+    idx = np.array([7, 0, 3])
+    out = np.asarray(select_columns(X_dev, idx))
+    np.testing.assert_array_equal(out, X_host[:, idx])
+    assert select_columns(X_dev, np.array([], np.int64)).shape == (257, 0)

@@ -169,3 +169,14 @@ class TestDataStreamUtils:
         gs = list(window_all_and_process(stream2, GlobalWindows(), count_rows))
         assert len(gs) == 1
         np.testing.assert_array_equal(np.asarray(gs[0].column("n")), [10.0])
+
+
+def test_window_all_and_process_empty_stream():
+    from flink_ml_tpu import StreamTable
+    from flink_ml_tpu.common.window import GlobalWindows
+    from flink_ml_tpu.utils.datastream import window_all_and_process
+
+    out = window_all_and_process(
+        StreamTable.from_batches([]), GlobalWindows(), lambda t: t
+    )
+    assert list(out) == []
