@@ -15,11 +15,13 @@ import numpy as np
 
 from ...api import Transformer
 from ...common.param import HasCategoricalCols, HasInputCols, HasNumFeatures, HasOutputCol
+from ...native import hashkernels as _native
 from ...table import SparseBatch, Table, rows_to_sparse_batch
 from ...utils.hashing import (
     murmur3_batch_unencoded_chars,
     murmur3_hash_unencoded_chars,
 )
+from .stringindexer import _java_double_to_string
 
 
 def _hash_index(s: str, num_features: int) -> int:
@@ -28,6 +30,49 @@ def _hash_index(s: str, num_features: int) -> int:
     h = murmur3_hash_unencoded_chars(s)
     h = h if h == -(2**31) else abs(h)
     return h % num_features
+
+
+def _render_java_doubles(values: np.ndarray) -> np.ndarray:
+    """Vectorized Java Double.toString: numpy's shortest-repr rendering
+    (identical digits) with per-row fixups where the forms diverge —
+    |v| outside [1e-3, 1e7), non-finite, and negative zero."""
+    s = values.astype(str)
+    a = np.abs(values)
+    bad = ~((a >= 1e-3) & (a < 1e7)) & (a != 0)
+    bad |= ~np.isfinite(values)
+    if bad.any():
+        idx = np.nonzero(bad)[0]
+        fixed = [_java_double_to_string(float(values[i])) for i in idx]
+        width = max(s.dtype.itemsize // 4, max(len(x) for x in fixed))
+        s = s.astype(f"U{width}")
+        s[idx] = fixed
+    return s
+
+
+def _hash_categorical_column(values: np.ndarray, prefix: str, n_features: int) -> np.ndarray:
+    """Per-row bucket indices for one categorical column — native
+    single-pass render+hash when available, numpy murmur otherwise."""
+    if values.dtype == np.float64:
+        out = _native.hash_categorical_doubles(values, prefix, n_features)
+        if out is not None:
+            return out.astype(np.int64)
+        rendered = _render_java_doubles(values)
+    elif values.dtype.kind == "f":
+        # float32/16 keep their own shortest repr (Java Float.toString),
+        # not the repr of the widened double
+        rendered = values.astype(str)
+    elif values.dtype.kind == "b":
+        # java_str: Java Boolean.toString is lowercase
+        rendered = np.where(values, "true", "false")
+    else:
+        rendered = values.astype(str)
+    out = _native.hash_categorical_strings(rendered, prefix, n_features)
+    if out is not None:
+        return out.astype(np.int64)
+    strs = np.char.add(prefix, rendered)
+    h = murmur3_batch_unencoded_chars(strs)
+    h = np.where(h == -(2**31), h, np.abs(h))
+    return h % n_features
 
 
 class FeatureHasherParams(HasInputCols, HasCategoricalCols, HasOutputCol, HasNumFeatures):
@@ -56,6 +101,8 @@ class FeatureHasher(Transformer, FeatureHasherParams):
         def java_str(v) -> str:
             if isinstance(v, (bool, np.bool_)):
                 return "true" if v else "false"
+            if isinstance(v, (float, np.floating)):
+                return _java_double_to_string(float(v))
             return str(v)
 
         vectorizable = all(
@@ -75,20 +122,15 @@ class FeatureHasher(Transformer, FeatureHasherParams):
             for c in input_cols:
                 if c not in categorical:
                     continue
-                values = host_cols[c]
-                if values.dtype.kind == "b":
-                    # java_str: Java Boolean.toString is lowercase
-                    rendered = np.where(values, "true", "false")
-                else:
-                    rendered = values.astype(str)
-                strs = np.char.add(f"{c}=", rendered)
-                h = murmur3_batch_unencoded_chars(strs)
-                h = np.where(h == -(2**31), h, np.abs(h))
-                idx_cols.append(h % n_features)
+                idx_cols.append(_hash_categorical_column(host_cols[c], f"{c}=", n_features))
                 val_cols.append(np.ones(n, np.float64))
             idxs = np.stack(idx_cols, axis=1)
             vals = np.stack(val_cols, axis=1)
-            indices, values = _combine_hashed(idxs, vals)
+            combined = _native.combine_hashed(idxs, vals)
+            if combined is not None:
+                indices, values = combined
+            else:
+                indices, values = _combine_hashed(idxs, vals)
             return [
                 table.with_column(
                     self.get_output_col(),

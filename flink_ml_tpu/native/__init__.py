@@ -1,22 +1,24 @@
-"""ctypes loader for the native runtime library (native/src/datacache.cc).
+"""ctypes loader for the native runtime library (native/src/*.cc).
 
-Compiles the C++ source with g++ on first use (cached as a .so next to the
-source, keyed by source mtime) — the environment bakes the toolchain but no
-prebuilt artifacts. Falls back to `available() == False` when no compiler
-is present so pure-Python paths keep working.
+Compiles the C++ sources with g++ on first use (cached as a .so next to the
+sources, keyed by source mtimes) — the environment bakes the toolchain but
+no prebuilt artifacts. Falls back to `available() == False` when no
+compiler is present so pure-Python paths keep working.
 """
 
 from __future__ import annotations
 
 import ctypes
+import glob
 import os
 import subprocess
 from typing import Optional
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "src", "datacache.cc")
+_SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
+_SOURCES = sorted(glob.glob(os.path.join(_SRC_DIR, "*.cc")))
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_LIB = os.path.join(_BUILD_DIR, "libdatacache.so")
+_LIB = os.path.join(_BUILD_DIR, "libflinkmlnative.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
@@ -25,7 +27,7 @@ _load_error: Optional[str] = None
 def _compile() -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, _SRC],
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, *_SOURCES],
         check=True,
         capture_output=True,
     )
@@ -52,6 +54,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dc_spilled_bytes.argtypes = [p]
     lib.dc_parse_csv_doubles.restype = ctypes.c_long
     lib.dc_parse_csv_doubles.argtypes = [ctypes.c_char_p, u64, ctypes.c_void_p, u64]
+    i32, long_ = ctypes.c_int32, ctypes.c_long
+    lib.fh_hash_categorical_doubles.restype = None
+    lib.fh_hash_categorical_doubles.argtypes = [p, long_, p, long_, i32, p]
+    lib.fh_hash_categorical_utf32.restype = None
+    lib.fh_hash_categorical_utf32.argtypes = [p, long_, long_, p, long_, i32, p]
+    lib.fh_combine.restype = None
+    lib.fh_combine.argtypes = [p, p, long_, long_, p, p]
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -59,7 +68,10 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _load_error is not None:
         return _lib
     try:
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        if not _SOURCES:
+            raise OSError(f"no native sources under {_SRC_DIR}")
+        src_mtime = max(os.path.getmtime(s) for s in _SOURCES)
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < src_mtime:
             _compile()
         lib = ctypes.CDLL(_LIB)
         _declare(lib)

@@ -1,0 +1,106 @@
+"""ctypes surface of the native hashing-trick kernels (hashkernels.cc).
+
+Each helper returns None when the native library is unavailable or an
+input falls outside the kernel's envelope (oversized prefix, too many
+columns), in which case the caller keeps its numpy path — behavior, not
+speed, is the contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from . import load as _load_native
+
+_MAX_PREFIX = 64  # fh_hash_categorical_doubles renders into a 96-unit buffer
+_MAX_COLS = 64  # fh_combine per-row scratch
+
+
+def _prefix_units(prefix: str) -> Optional[np.ndarray]:
+    ords = [ord(c) for c in prefix]
+    if len(ords) > _MAX_PREFIX or any(o > 0xFFFF for o in ords):
+        return None  # non-BMP column name: caller's surrogate-aware fallback
+    return np.array(ords, dtype=np.uint16)
+
+
+def hash_categorical_doubles(
+    values: np.ndarray, prefix: str, num_features: int
+) -> Optional[np.ndarray]:
+    """Bucketed murmur3 of ``prefix + Double.toString(v)`` per row."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    pre = _prefix_units(prefix)
+    if pre is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    out = np.empty(len(values), dtype=np.int32)
+    lib.fh_hash_categorical_doubles(
+        values.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(len(values)),
+        pre.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(len(pre)),
+        ctypes.c_int32(num_features),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def hash_categorical_strings(
+    values: np.ndarray, prefix: str, num_features: int
+) -> Optional[np.ndarray]:
+    """Bucketed murmur3 of ``prefix + s`` per row of a numpy '<U' column."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    pre = _prefix_units(prefix)
+    if pre is None:
+        return None
+    S = np.asarray(values)
+    if S.dtype.kind != "U":
+        S = S.astype(str)
+    width = S.dtype.itemsize // 4
+    n = S.shape[0]
+    if width == 0:
+        S = S.astype("U1")
+        width = 1
+    buf = np.ascontiguousarray(S).view(np.uint32).reshape(n, width)
+    out = np.empty(n, dtype=np.int32)
+    lib.fh_hash_categorical_utf32(
+        buf.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(n),
+        ctypes.c_long(width),
+        pre.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(len(pre)),
+        ctypes.c_int32(num_features),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def combine_hashed(
+    idxs: np.ndarray, vals: np.ndarray
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Per-row sort + duplicate-sum of (bucket, value) pairs → padded CSR."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    n, k = idxs.shape
+    if k > _MAX_COLS:
+        return None
+    idxs = np.ascontiguousarray(idxs, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    out_idx = np.empty((n, k), dtype=np.int32)
+    out_val = np.empty((n, k), dtype=np.float64)
+    lib.fh_combine(
+        idxs.ctypes.data_as(ctypes.c_void_p),
+        vals.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(n),
+        ctypes.c_long(k),
+        out_idx.ctypes.data_as(ctypes.c_void_p),
+        out_val.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_idx, out_val

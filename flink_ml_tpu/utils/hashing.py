@@ -125,7 +125,11 @@ def murmur3_batch_unencoded_chars(strings, seed: int = 0):
         return np.asarray(
             [murmur3_hash_unencoded_chars(str(s), seed) for s in S], np.int64
         )
-    lens = (U != 0).sum(axis=1).astype(np.int64)
+    # length = last nonzero + 1: zeros BEFORE it are real embedded U+0000
+    # characters (Java hashes them); numpy cannot represent trailing ones.
+    nz = U != 0
+    lens = (M - np.argmax(nz[:, ::-1], axis=1)).astype(np.int64)
+    lens[~nz.any(axis=1)] = 0
 
     MASK = np.uint64(_M)
 

@@ -1,0 +1,227 @@
+// Native hashing-trick kernels — single-pass render+hash+bucket for the
+// FeatureHasher/HashingTF hot path.
+//
+// The reference hashes categorical cells as guava murmur3_32(0) over the
+// UTF-16 code units of "col=" + String.valueOf(cell)
+// (flink-ml-lib/.../feature/featurehasher/FeatureHasher.java:60-118), then
+// buckets with Math.abs + mod. On a single-core host the Python/numpy
+// pipeline (render 30M doubles to strings, concat, vectorized murmur)
+// costs minutes at benchmark scale; this C path renders each double with
+// Java Double.toString semantics (shortest round-trip digits via
+// std::to_chars, Java's decimal/scientific form switch at 1e-3/1e7) and
+// hashes it in one pass without materializing Python strings.
+//
+// Build: compiled together with datacache.cc into the runtime .so
+// (flink_ml_tpu/native/__init__.py).
+
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kC1 = 0xcc9e2d51u;
+constexpr uint32_t kC2 = 0x1b873593u;
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= kC1;
+  k1 = rotl32(k1, 15);
+  return k1 * kC2;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5 + 0xe6546b64u;
+}
+
+inline uint32_t fmix(uint32_t h1, uint32_t length) {
+  h1 ^= length;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+// guava Murmur3_32.hashUnencodedChars over UTF-16 code units.
+inline int32_t murmur3_units(const uint16_t* units, long len) {
+  uint32_t h1 = 0;
+  long i = 0;
+  for (; i + 1 < len; i += 2) {
+    uint32_t k1 = (uint32_t)units[i] | ((uint32_t)units[i + 1] << 16);
+    h1 = mix_h1(h1, mix_k1(k1));
+  }
+  if (i < len) h1 ^= mix_k1((uint32_t)units[i]);
+  return (int32_t)fmix(h1, (uint32_t)(2 * len));
+}
+
+// FeatureHasher.updateMap bucketing: Math.abs (keeping Integer.MIN_VALUE)
+// then a non-negative mod.
+inline int32_t bucket(int32_t h, int32_t num_features) {
+  if (h != INT32_MIN && h < 0) h = -h;
+  int32_t m = h % num_features;
+  return m < 0 ? m + num_features : m;
+}
+
+// Java Double.toString(v) rendered as UTF-16 units appended at `out`;
+// returns the number of units written. Digits are the shortest round-trip
+// sequence (std::to_chars scientific), placed decimal-style for
+// 1e-3 <= |v| < 1e7 and as d.dddE±x otherwise — the Double.toString
+// contract. (Same JDK<19 shortest-digit caveat as
+// models/feature/stringindexer.py:_java_double_to_string.)
+inline long render_java_double(double v, uint16_t* out) {
+  long n = 0;
+  if (std::isnan(v)) {
+    for (const char* p = "NaN"; *p; ++p) out[n++] = (uint16_t)*p;
+    return n;
+  }
+  if (std::signbit(v) && !std::isnan(v)) out[n++] = '-';
+  if (std::isinf(v)) {
+    for (const char* p = "Infinity"; *p; ++p) out[n++] = (uint16_t)*p;
+    return n;
+  }
+  double a = std::fabs(v);
+  if (a == 0.0) {
+    out[n++] = '0'; out[n++] = '.'; out[n++] = '0';
+    return n;
+  }
+  char buf[40];
+  auto res = std::to_chars(buf, buf + sizeof(buf), a, std::chars_format::scientific);
+  // parse "d[.ddd]e±xx" into digit string + decimal exponent
+  char digits[24];
+  int nd = 0;
+  int exp10 = 0;
+  {
+    const char* p = buf;
+    digits[nd++] = *p++;
+    if (*p == '.') {
+      ++p;
+      while (p < res.ptr && *p != 'e') digits[nd++] = *p++;
+    }
+    // *p == 'e'
+    ++p;
+    bool neg = (*p == '-');
+    if (*p == '+' || *p == '-') ++p;
+    while (p < res.ptr) exp10 = exp10 * 10 + (*p++ - '0');
+    if (neg) exp10 = -exp10;
+  }
+  if (exp10 >= -3 && exp10 <= 6) {  // decimal form
+    if (exp10 >= 0) {
+      int i = 0;
+      for (; i <= exp10; ++i) out[n++] = (uint16_t)(i < nd ? digits[i] : '0');
+      out[n++] = '.';
+      if (i >= nd) {
+        out[n++] = '0';
+      } else {
+        for (; i < nd; ++i) out[n++] = (uint16_t)digits[i];
+      }
+    } else {
+      out[n++] = '0'; out[n++] = '.';
+      for (int z = 0; z < -exp10 - 1; ++z) out[n++] = '0';
+      for (int i = 0; i < nd; ++i) out[n++] = (uint16_t)digits[i];
+    }
+  } else {  // scientific form d.dddE±x, no '+', no leading exponent zeros
+    out[n++] = (uint16_t)digits[0];
+    out[n++] = '.';
+    if (nd == 1) {
+      out[n++] = '0';
+    } else {
+      for (int i = 1; i < nd; ++i) out[n++] = (uint16_t)digits[i];
+    }
+    out[n++] = 'E';
+    if (exp10 < 0) { out[n++] = '-'; exp10 = -exp10; }
+    char eb[8];
+    int ne = 0;
+    while (exp10 > 0) { eb[ne++] = (char)('0' + exp10 % 10); exp10 /= 10; }
+    while (ne > 0) out[n++] = (uint16_t)eb[--ne];
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Bucketed hash of "prefix" + Double.toString(vals[i]) for each row.
+// `prefix` holds UTF-16 units (BMP column names; the caller checks).
+void fh_hash_categorical_doubles(const double* vals, long n,
+                                 const uint16_t* prefix, long prefix_len,
+                                 int32_t num_features, int32_t* out) {
+  uint16_t units[96];
+  for (long j = 0; j < prefix_len; ++j) units[j] = prefix[j];
+  for (long i = 0; i < n; ++i) {
+    long len = prefix_len + render_java_double(vals[i], units + prefix_len);
+    out[i] = bucket(murmur3_units(units, len), num_features);
+  }
+}
+
+// Bucketed hash of "prefix" + row for a numpy '<U' column: `units32` is the
+// raw UTF-32 buffer, `width` code points per row, NUL-padded. A row's length
+// is last-nonzero+1 (embedded U+0000 are real characters; numpy cannot
+// represent trailing ones). Astral code points are split into surrogate
+// pairs, matching Java's UTF-16 storage.
+void fh_hash_categorical_utf32(const uint32_t* units32, long n, long width,
+                               const uint16_t* prefix, long prefix_len,
+                               int32_t num_features, int32_t* out) {
+  const long kMax = prefix_len + 2 * width + 4;
+  uint16_t stack_units[256];
+  uint16_t* units = kMax <= 256 ? stack_units : new uint16_t[kMax];
+  for (long j = 0; j < prefix_len; ++j) units[j] = prefix[j];
+  for (long i = 0; i < n; ++i) {
+    const uint32_t* row = units32 + i * width;
+    long wlen = width;
+    while (wlen > 0 && row[wlen - 1] == 0) --wlen;
+    long len = prefix_len;
+    for (long j = 0; j < wlen; ++j) {
+      uint32_t cp = row[j];
+      if (cp > 0xFFFF) {
+        cp -= 0x10000;
+        units[len++] = (uint16_t)(0xD800 + (cp >> 10));
+        units[len++] = (uint16_t)(0xDC00 + (cp & 0x3FF));
+      } else {
+        units[len++] = (uint16_t)cp;
+      }
+    }
+    out[i] = bucket(murmur3_units(units, len), num_features);
+  }
+  if (units != stack_units) delete[] units;
+}
+
+// Merge each row's k (bucket, value) pairs into ascending-index padded CSR:
+// equal buckets sum (TreeMap order of FeatureHasher.updateMap), -1 padding.
+void fh_combine(const int32_t* idx, const double* val, long n, long k,
+                int32_t* out_idx, double* out_val) {
+  int32_t ib[64];
+  double vb[64];
+  for (long r = 0; r < n; ++r) {
+    const int32_t* ri = idx + r * k;
+    const double* rv = val + r * k;
+    long m = 0;
+    for (long j = 0; j < k; ++j) {  // insertion sort + duplicate merge
+      int32_t key = ri[j];
+      double value = rv[j];
+      long lo = m;
+      while (lo > 0 && ib[lo - 1] >= key) --lo;
+      if (lo < m && ib[lo] == key) {
+        vb[lo] += value;
+        continue;
+      }
+      for (long s = m; s > lo; --s) { ib[s] = ib[s - 1]; vb[s] = vb[s - 1]; }
+      ib[lo] = key;
+      vb[lo] = value;
+      ++m;
+    }
+    int32_t* oi = out_idx + r * k;
+    double* ov = out_val + r * k;
+    long j = 0;
+    for (; j < m; ++j) { oi[j] = ib[j]; ov[j] = vb[j]; }
+    for (; j < k; ++j) { oi[j] = -1; ov[j] = 0.0; }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+"""Native hashing-trick kernels (native/src/hashkernels.cc) vs the
+pure-Python oracles — bit-identical bucketing is the contract
+(FeatureHasher.java:60-118 hashes guava murmur3_32(0) over
+"col=" + String.valueOf(cell))."""
+
+import numpy as np
+import pytest
+
+import flink_ml_tpu.native as nat
+from flink_ml_tpu.native import hashkernels as hk
+from flink_ml_tpu.models.feature.featurehasher import (
+    _combine_hashed,
+    _hash_categorical_column,
+    _hash_index,
+    _render_java_doubles,
+)
+from flink_ml_tpu.models.feature.stringindexer import _java_double_to_string
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.utils.hashing import (
+    murmur3_batch_unencoded_chars,
+    murmur3_hash_unencoded_chars,
+)
+
+pytestmark = pytest.mark.skipif(not nat.available(), reason="no native toolchain")
+
+
+def _double_fixture():
+    rng = np.random.default_rng(42)
+    return np.concatenate(
+        [
+            rng.random(500),  # benchmark regime: uniform [0, 1)
+            rng.random(50) * 1e-4,  # scientific form below 1e-3
+            rng.random(50) * 1e9,  # scientific form at/above 1e7
+            -rng.random(50),
+            np.array(
+                [0.0, -0.0, 1.0, -1.5, 1e-3, 1e7, 12345678.0, 1e-4,
+                 np.nan, np.inf, -np.inf, 4.9e-324, 2.0**31, 2.0**63]
+            ),
+        ]
+    )
+
+
+def test_double_hash_matches_scalar_oracle():
+    v = _double_fixture()
+    got = hk.hash_categorical_doubles(v, "f0=", 1000)
+    exp = [_hash_index("f0=" + _java_double_to_string(float(x)), 1000) for x in v]
+    assert got.tolist() == exp
+
+
+def test_string_hash_matches_scalar_oracle():
+    strs = np.array(["hello", "a\x00b", "emoji\U0001F600x", "", "x", "true", "0.5"])
+    got = hk.hash_categorical_strings(strs, "c=", 997)
+    exp = [_hash_index("c=" + s, 997) for s in strs]
+    assert got.tolist() == exp
+
+
+def test_combine_matches_numpy():
+    rng = np.random.default_rng(1)
+    idxs = rng.integers(0, 20, size=(300, 5)).astype(np.int64)
+    vals = rng.random((300, 5))
+    ci, cv = hk.combine_hashed(idxs, vals)
+    ri, rv = _combine_hashed(idxs, vals)
+    assert np.array_equal(ci, ri)
+    np.testing.assert_allclose(cv, rv)
+
+
+def test_render_java_doubles_fallback_matches_scalar():
+    v = _double_fixture()
+    rendered = _render_java_doubles(v)
+    exp = [_java_double_to_string(float(x)) for x in v]
+    assert rendered.tolist() == exp
+
+
+def test_column_path_native_and_fallback_agree(monkeypatch):
+    v = _double_fixture()
+    native = _hash_categorical_column(v, "f2=", 263)
+    monkeypatch.setattr(hk, "_load_native", lambda: None)
+    fallback = _hash_categorical_column(v, "f2=", 263)
+    assert native.tolist() == fallback.tolist()
+
+
+def test_numpy_batch_murmur_embedded_nul():
+    strs = np.array(["a\x00b", "\x00x", "hello", "x"])
+    got = murmur3_batch_unencoded_chars(strs)
+    exp = [murmur3_hash_unencoded_chars(s) for s in strs]
+    assert got.tolist() == exp
+
+
+def test_featurehasher_java_form_small_values():
+    """Values below 1e-3 must hash their Java scientific rendering
+    ('1.0E-4'), not the Python decimal form ('0.0001')."""
+    from flink_ml_tpu.models.feature.featurehasher import FeatureHasher
+
+    t = Table({"f0": np.array([1e-4, 0.0005, 12345678.0, 0.5])})
+    out = (
+        FeatureHasher()
+        .set_input_cols("f0")
+        .set_categorical_cols("f0")
+        .set_num_features(1 << 18)
+        .transform(t)[0]
+        .column("output")
+    )
+    exp = [
+        _hash_index("f0=" + _java_double_to_string(v), 1 << 18)
+        for v in [1e-4, 0.0005, 12345678.0, 0.5]
+    ]
+    for r, e in enumerate(exp):
+        assert out.row(r).indices.tolist() == [e]
