@@ -62,12 +62,12 @@ def _enable_compilation_cache():
         pass
 
 
-def _make_logreg(num_rows):
+def _make_logreg(num_rows, max_iter=MAX_ITER):
     from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
 
     return (
         LogisticRegression()
-        .set_max_iter(MAX_ITER)
+        .set_max_iter(max_iter)
         .set_learning_rate(LR_RATE)
         .set_global_batch_size(min(BATCH, num_rows))
         .set_tol(TOL)
@@ -145,7 +145,83 @@ def bench_logreg(num_rows, in_budget=lambda: True):
         "inputThroughput": num_rows / warm,
         "throughputPerChip": num_rows / warm / n_chips,
         "numChips": n_chips,
+        # flop-model fallback; overwritten with the profiler-trace MFU by
+        # the trace stage when it runs (trainLoopMFUSource says which)
         "trainLoopMFU": mfu,
+        "trainLoopMFUSource": "flop_model_fallback",
+    }
+
+
+def bench_logreg_trace(num_rows):
+    """Profiler-trace evidence for the headline fit (round-3/4 ask): ONE
+    warm fit under jax.profiler, reduced to device-busy time, measured HBM
+    traffic, and executed FLOPs — the MFU from the device timeline rather
+    than a flop model, and an explicit name for what the wall actually is
+    (device compute vs the remote tunnel's dispatch+readback latency)."""
+    from flink_ml_tpu.utils.traceprof import capture_trace
+
+    table = _gen_table(num_rows, seed=2)
+    np.asarray(table.column("label")[:1])  # barrier: keep datagen off the trace
+    stats = capture_trace(lambda: _make_logreg(num_rows).fit(table))
+    if "error" in stats:
+        return stats
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))
+    peak_hbm = float(os.environ.get("BENCH_PEAK_HBM_GBPS", "819"))  # v5e-class
+    busy_s = stats["deviceBusyMs"] / 1000.0
+    stats["peakFlops"] = peak
+    stats["trainLoopMFU_trace"] = (
+        stats["modelFlops"] / busy_s / peak if busy_s > 0 else None
+    )
+    # this workload is bandwidth-bound (arithmetic intensity ~0.5 flop/byte),
+    # so HBM utilization, not MFU, is the roofline that matters
+    stats["peakHbmGBps"] = peak_hbm
+    stats["hbmUtilization"] = (
+        stats["hbmGBps"] / peak_hbm if stats["hbmGBps"] else None
+    )
+    stats["hostDispatchMs"] = stats["wallMs"] - stats["deviceBusyMs"]
+    stats["wallIs"] = (
+        "tunnel-dispatch+readback-latency"
+        if stats["deviceBusyMs"] < 0.5 * stats["wallMs"]
+        else "device-compute"
+    )
+    if stats["hbmGBps"] is not None:
+        log(
+            f"trace: wall {stats['wallMs']:.0f} ms, device busy {stats['deviceBusyMs']:.1f} ms, "
+            f"HBM {stats['hbmGBps']:.0f} GB/s ({stats['hbmUtilization']:.0%} of roofline), "
+            f"MFU(trace) {stats['trainLoopMFU_trace']:.4f}, wall is {stats['wallIs']}"
+        )
+    else:
+        log(f"trace: wall {stats['wallMs']:.0f} ms, no device activity recorded")
+    return stats
+
+
+def bench_logreg_amortized(num_rows, max_iter=200, in_budget=lambda: True):
+    """Same headline workload at maxIter 200: amortizes the fixed ~100ms
+    tunnel dispatch+readback floor over 10x the training work, showing the
+    train loop's own throughput. trainedExamplesPerSec counts SGD work
+    actually done (batch records x epochs per second); epochMsAmortized is
+    the per-epoch cost once the fixed floor is spread thin."""
+    runs = []
+    for i in range(3):
+        if i > 0 and len(runs) > 1 and not in_budget():
+            break
+        t0 = time.perf_counter()
+        table = _gen_table(num_rows, seed=2 + i)
+        _make_logreg(num_rows, max_iter=max_iter).fit(table)
+        runs.append(time.perf_counter() - t0)
+        log(
+            f"logreg maxIter={max_iter} run {i}: {runs[-1] * 1000:.0f} ms"
+            + (" (cold: includes compile)" if i == 0 else "")
+        )
+    warm = min(runs[1:] or runs)
+    return {
+        "maxIter": max_iter,
+        "coldTimeMs": runs[0] * 1000.0,
+        "totalTimeMs": warm * 1000.0,
+        "inputRecordNum": num_rows,
+        "inputThroughput": num_rows / warm,
+        "trainedExamplesPerSec": min(BATCH, num_rows) * max_iter / warm,
+        "epochMsAmortized": warm * 1000.0 / max_iter,
     }
 
 
@@ -324,6 +400,8 @@ def main(argv):
 
     details = {
         "logisticregression": None,
+        "logisticregressionTrace": None,
+        "logisticregressionAmortized": None,
         "lossParity": None,
         "cpuBaseline": None,
         "sparseWideLR": None,
@@ -340,6 +418,27 @@ def main(argv):
             value = details["logisticregression"]["throughputPerChip"]
         except Exception as e:
             log(f"logisticregression stage failed: {e!r}")
+
+        if in_budget():
+            try:  # reuses the executables the warm runs just compiled
+                details["logisticregressionTrace"] = bench_logreg_trace(logreg_rows)
+                if details["logisticregression"] is not None and isinstance(
+                    details["logisticregressionTrace"].get("trainLoopMFU_trace"), float
+                ):
+                    details["logisticregression"]["trainLoopMFU"] = details[
+                        "logisticregressionTrace"
+                    ]["trainLoopMFU_trace"]
+                    details["logisticregression"]["trainLoopMFUSource"] = "profiler_trace"
+            except Exception as e:
+                log(f"logisticregression trace stage failed: {e!r}")
+
+        if in_budget(reserve=60.0):
+            try:
+                details["logisticregressionAmortized"] = bench_logreg_amortized(
+                    logreg_rows, in_budget=in_budget
+                )
+            except Exception as e:
+                log(f"logisticregression amortized stage failed: {e!r}")
 
         if "--skip-parity" not in argv and in_budget():
             try:

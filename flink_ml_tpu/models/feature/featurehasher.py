@@ -21,7 +21,7 @@ from ...utils.hashing import (
     murmur3_batch_unencoded_chars,
     murmur3_hash_unencoded_chars,
 )
-from .stringindexer import _java_double_to_string
+from .stringindexer import _java_double_to_string, _java_float_to_string
 
 
 def _hash_index(s: str, num_features: int) -> int:
@@ -32,21 +32,26 @@ def _hash_index(s: str, num_features: int) -> int:
     return h % num_features
 
 
-def _render_java_doubles(values: np.ndarray) -> np.ndarray:
-    """Vectorized Java Double.toString: numpy's shortest-repr rendering
-    (identical digits) with per-row fixups where the forms diverge —
-    |v| outside [1e-3, 1e7), non-finite, and negative zero."""
+def _render_java_floats(values: np.ndarray, scalar_fmt) -> np.ndarray:
+    """Vectorized Java Double/Float.toString: numpy's shortest-repr
+    rendering (identical digits at the column's own precision) with
+    per-row fixups where the forms diverge — |v| outside [1e-3, 1e7),
+    non-finite, and negative zero."""
     s = values.astype(str)
     a = np.abs(values)
     bad = ~((a >= 1e-3) & (a < 1e7)) & (a != 0)
     bad |= ~np.isfinite(values)
     if bad.any():
         idx = np.nonzero(bad)[0]
-        fixed = [_java_double_to_string(float(values[i])) for i in idx]
+        fixed = [scalar_fmt(values[i]) for i in idx]
         width = max(s.dtype.itemsize // 4, max(len(x) for x in fixed))
         s = s.astype(f"U{width}")
         s[idx] = fixed
     return s
+
+
+def _render_java_doubles(values: np.ndarray) -> np.ndarray:
+    return _render_java_floats(values, lambda v: _java_double_to_string(float(v)))
 
 
 def _hash_categorical_column(values: np.ndarray, prefix: str, n_features: int) -> np.ndarray:
@@ -58,9 +63,11 @@ def _hash_categorical_column(values: np.ndarray, prefix: str, n_features: int) -
             return out.astype(np.int64)
         rendered = _render_java_doubles(values)
     elif values.dtype.kind == "f":
-        # float32/16 keep their own shortest repr (Java Float.toString),
+        # float32/16 render at float32 precision (Java Float.toString),
         # not the repr of the widened double
-        rendered = values.astype(str)
+        rendered = _render_java_floats(
+            values.astype(np.float32), _java_float_to_string
+        )
     elif values.dtype.kind == "b":
         # java_str: Java Boolean.toString is lowercase
         rendered = np.where(values, "true", "false")
@@ -101,12 +108,14 @@ class FeatureHasher(Transformer, FeatureHasherParams):
         def java_str(v) -> str:
             if isinstance(v, (bool, np.bool_)):
                 return "true" if v else "false"
+            if isinstance(v, (np.float32, np.float16)):
+                return _java_float_to_string(v)
             if isinstance(v, (float, np.floating)):
                 return _java_double_to_string(float(v))
             return str(v)
 
         vectorizable = all(
-            arr.ndim == 1 and arr.dtype.kind in "fiub" for arr in host_cols.values()
+            arr.ndim == 1 and arr.dtype.kind in "fiubU" for arr in host_cols.values()
         )
         if vectorizable and input_cols:
             # vectorized path: bucket indices come from batch murmur over

@@ -32,16 +32,12 @@ ALPHABET_DESC_ORDER = "alphabetDesc"
 ALPHABET_ASC_ORDER = "alphabetAsc"
 
 
-def _java_double_to_string(v: float) -> str:
-    """Java Double.toString semantics: decimal form for 1e-3 <= |v| < 1e7,
-    otherwise d.dddE±x scientific form (e.g. '1.0E7', '1.0E-4'), with
-    'NaN'/'Infinity'/'0.0' specials. Needed so numeric columns index
-    identically to reference-written StringIndexer models.
-
-    Known limit: digits come from Python's shortest round-trip repr; the
-    legacy (pre-JDK19) FloatingDecimal occasionally emits non-shortest
-    digits (e.g. Double.MIN_VALUE prints '4.9E-324' there, '5.0E-324'
-    here). Only subnormal-magnitude keys are affected."""
+def _java_fp_to_string(v: float, shortest_repr) -> str:
+    """Shared Double.toString/Float.toString form contract: decimal form
+    for 1e-3 <= |v| < 1e7, otherwise d.dddE±x scientific (e.g. '1.0E7',
+    '1.0E-4'), with 'NaN'/'Infinity'/'0.0' specials. ``shortest_repr``
+    supplies the shortest round-trip digits at the value's own precision
+    (float64 vs float32)."""
     if math.isnan(v):
         return "NaN"
     if math.isinf(v):
@@ -51,17 +47,36 @@ def _java_double_to_string(v: float) -> str:
     if a == 0:
         return sign + "0.0"
     if 1e-3 <= a < 1e7:
-        s = repr(a)
-        if "." not in s:
+        s = shortest_repr(a)
+        if "." not in s and "e" not in s and "E" not in s:
             s += ".0"
         return sign + s
-    dec = Decimal(repr(a))
+    dec = Decimal(shortest_repr(a))
     _, digits, dexp = dec.as_tuple()
     ds = "".join(map(str, digits))
     exp = len(ds) - 1 + dexp
     ds = ds.rstrip("0") or "0"
     frac = ds[1:] or "0"
     return f"{sign}{ds[0]}.{frac}E{exp}"
+
+
+def _java_double_to_string(v: float) -> str:
+    """Java Double.toString semantics. Needed so numeric columns index
+    identically to reference-written StringIndexer models.
+
+    Known limit: digits come from Python's shortest round-trip repr; the
+    legacy (pre-JDK19) FloatingDecimal occasionally emits non-shortest
+    digits (e.g. Double.MIN_VALUE prints '4.9E-324' there, '5.0E-324'
+    here). Only subnormal-magnitude keys are affected."""
+    return _java_fp_to_string(float(v), repr)
+
+
+def _java_float_to_string(v) -> str:
+    """Java Float.toString semantics: same form contract as Double.toString
+    but digits are the float32 shortest round-trip sequence."""
+    f = np.float32(v)
+    # str(), not repr(): numpy 2 scalar repr is 'np.float32(0.1)'
+    return _java_fp_to_string(float(f), lambda a: str(np.float32(a)))
 
 
 def _to_string(value) -> str:
