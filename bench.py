@@ -176,7 +176,7 @@ def bench_logreg_trace(num_rows):
     # so HBM utilization, not MFU, is the roofline that matters
     stats["peakHbmGBps"] = peak_hbm
     stats["hbmUtilization"] = (
-        stats["hbmGBps"] / peak_hbm if stats["hbmGBps"] else None
+        stats["hbmGBps"] / peak_hbm if stats["hbmGBps"] is not None else None
     )
     stats["hostDispatchMs"] = stats["wallMs"] - stats["deviceBusyMs"]
     stats["wallIs"] = (

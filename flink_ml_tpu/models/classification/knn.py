@@ -84,7 +84,9 @@ class KnnModel(Model, KnnModelParams):
         read_write.save_model_arrays(path, features=self.features, labels=self.labels)
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(path, javacodec.load_reference_knn)
         self.features, self.labels = arrays["features"], arrays["labels"]
 
 

@@ -115,7 +115,11 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_naivebayes
+        )
         self.theta = [list(row) for row in arrays["theta"]]
         self.pi = arrays["piArray"]
         self.labels = arrays["labels"]

@@ -171,7 +171,12 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        self.vocabulary = [str(v) for v in read_write.load_model_arrays(path)["vocabulary"]]
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_countvectorizer
+        )
+        self.vocabulary = [str(v) for v in arrays["vocabulary"]]
 
 
 class CountVectorizer(Estimator, CountVectorizerParams):

@@ -95,7 +95,9 @@ class IDFModel(Model, IDFModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(path, javacodec.load_reference_idf)
         self.idf = arrays["idf"]
         self.doc_freq = arrays["docFreq"]
         self.num_docs = int(arrays["numDocs"])

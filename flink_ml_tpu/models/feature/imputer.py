@@ -98,7 +98,11 @@ class ImputerModel(Model, ImputerModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_imputer
+        )
         self.surrogates = {
             str(k): float(v) for k, v in zip(arrays["columnNames"], arrays["values"])
         }

@@ -131,7 +131,11 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_kbinsdiscretizer
+        )
         self.bin_edges = [np.asarray(e, dtype=np.float64) for e in arrays["binEdges"]]
 
 

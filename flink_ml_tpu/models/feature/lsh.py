@@ -194,7 +194,11 @@ class MinHashLSHModel(Model, LSHParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_minhashlsh
+        )
         self.rand_coefficient_a = arrays["randCoefficientA"]
         self.rand_coefficient_b = arrays["randCoefficientB"]
 

@@ -49,7 +49,11 @@ class MaxAbsScalerModel(Model, MaxAbsScalerParams):
         read_write.save_model_arrays(path, maxVector=self.max_abs)
 
     def _load_extra(self, path: str) -> None:
-        self.max_abs = read_write.load_model_arrays(path)["maxVector"]
+        from ...utils import javacodec
+
+        self.max_abs = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_maxabsscaler
+        )["maxVector"]
 
 
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):

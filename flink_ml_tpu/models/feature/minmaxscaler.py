@@ -84,7 +84,11 @@ class MinMaxScalerModel(Model, MinMaxScalerParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_minmaxscaler
+        )
         self.min_vector, self.max_vector = arrays["minVector"], arrays["maxVector"]
 
 

@@ -87,7 +87,11 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
         read_write.save_model_arrays(path, categorySizes=self.category_sizes)
 
     def _load_extra(self, path: str) -> None:
-        self.category_sizes = read_write.load_model_arrays(path)["categorySizes"]
+        from ...utils import javacodec
+
+        self.category_sizes = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_onehotencoder
+        )["categorySizes"]
 
 
 class OneHotEncoder(Estimator, OneHotEncoderParams):

@@ -113,7 +113,11 @@ class RobustScalerModel(Model, RobustScalerModelParams):
         read_write.save_model_arrays(path, medians=self.medians, ranges=self.ranges)
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_robustscaler
+        )
         self.medians, self.ranges = arrays["medians"], arrays["ranges"]
 
 

@@ -87,7 +87,11 @@ class StandardScalerModel(Model, StandardScalerParams):
         read_write.save_model_arrays(path, mean=self.mean, std=self.std)
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_standardscaler
+        )
         self.mean, self.std = arrays["mean"], arrays["std"]
 
 

@@ -191,7 +191,11 @@ class StringIndexerModel(Model, StringIndexerModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_stringindexer
+        )
         self.string_arrays = [list(a) for a in arrays["stringArrays"]]
 
 
@@ -241,7 +245,11 @@ class IndexToStringModel(Model, IndexToStringModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_stringindexer
+        )
         self.string_arrays = [list(a) for a in arrays["stringArrays"]]
 
 

@@ -147,7 +147,11 @@ class UnivariateFeatureSelectorModel(Model, UnivariateFeatureSelectorModelParams
         read_write.save_model_arrays(path, indices=self.indices)
 
     def _load_extra(self, path: str) -> None:
-        self.indices = read_write.load_model_arrays(path)["indices"]
+        from ...utils import javacodec
+
+        self.indices = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_univariatefeatureselector
+        )["indices"]
 
 
 class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):

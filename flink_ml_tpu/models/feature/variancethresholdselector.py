@@ -69,7 +69,11 @@ class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams
         read_write.save_model_arrays(path, indices=self.indices)
 
     def _load_extra(self, path: str) -> None:
-        self.indices = read_write.load_model_arrays(path)["indices"]
+        from ...utils import javacodec
+
+        self.indices = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_variancethresholdselector
+        )["indices"]
 
 
 @jax.jit

@@ -110,7 +110,11 @@ class VectorIndexerModel(Model, VectorIndexerModelParams):
         )
 
     def _load_extra(self, path: str) -> None:
-        arrays = read_write.load_model_arrays(path)
+        from ...utils import javacodec
+
+        arrays = read_write.load_arrays_or_reference(
+            path, javacodec.load_reference_vectorindexer
+        )
         self.category_maps = {
             int(c): {float(v): i for i, v in enumerate(keys)}
             for c, keys in zip(arrays["columns"], arrays["keys"])

@@ -106,6 +106,19 @@ class TestReferenceFixtures:
             read_write.load_stage(str(stage_dir))
 
 
+def test_all_family_fixtures_load():
+    """Every committed reference_{family}_model directory (one per
+    model-data codec family, scripts/make_reference_fixture.py) must
+    resolve its Java class name and decode its binary part file."""
+    import glob as _glob
+
+    dirs = sorted(_glob.glob(os.path.join(FIXTURES, "reference_*_model")))
+    assert len(dirs) >= 17  # kmeans + 16 codec families
+    for d in dirs:
+        stage = read_write.load_stage(d)
+        assert stage is not None, d
+
+
 class TestPartFileHandling:
     def test_numeric_part_order(self, tmp_path):
         """part-0-10 must sort after part-0-9 so the LAST record wins."""
