@@ -98,16 +98,92 @@ def _lance_williams_update(d_ik, d_jk, d_ij, size_i, size_j, size_k, linkage):
     )
 
 
+_LINKAGE_CODES = {
+    LINKAGE_SINGLE: 0,
+    LINKAGE_COMPLETE: 1,
+    LINKAGE_AVERAGE: 2,
+    LINKAGE_WARD: 3,
+}
+
+
+def _cluster_block_native(dist, linkage, num_clusters, threshold, compute_full_tree):
+    """Run the merge loop in C (native/src/agglomerative.cc — the same
+    algorithm and arithmetic as the numpy loop below, ~100x faster on this
+    single-core host). Returns (pred, merges) or None without the lib."""
+    import ctypes
+
+    from ...native import load as _load_native
+
+    lib = _load_native()
+    if lib is None:
+        return None
+    n = dist.shape[0]
+    dist = np.ascontiguousarray(dist)  # consumed in place; caller is done with it
+    merges_out = np.empty((max(n - 1, 1), 4), dtype=np.float64)
+    pred = np.empty(n, dtype=np.int32)
+    num = lib.agg_cluster(
+        dist.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(n),
+        ctypes.c_int(_LINKAGE_CODES[linkage]),
+        ctypes.c_double(threshold if threshold is not None else 0.0),
+        ctypes.c_int(1 if threshold is not None else 0),
+        ctypes.c_long(num_clusters),
+        ctypes.c_int(1 if compute_full_tree else 0),
+        merges_out.ctypes.data_as(ctypes.c_void_p),
+        pred.ctypes.data_as(ctypes.c_void_p),
+    )
+    merges = [
+        (int(a), int(b), float(d), int(s)) for a, b, d, s in merges_out[:num]
+    ]
+    _, pred = np.unique(pred, return_inverse=True)
+    return pred.astype(np.int32), merges
+
+
+def _pairwise_host(X: np.ndarray, measure_name: str):
+    """Float64 pairwise distances in host numpy, mirroring
+    ops/distance.py's formulas. The local clustering consumes the full
+    (n, n) matrix on the host anyway, and the reference's
+    LocalAgglomerativeClusteringFunction computes CPU doubles — device
+    pairwise would add an (n, n) D2H readback (~240 ms at n=1000 over the
+    remote tunnel) for LESS precision. None for unknown measures."""
+    X = np.asarray(X, dtype=np.float64)
+    if measure_name == "euclidean":
+        x2 = np.einsum("ij,ij->i", X, X)
+        sq = x2[:, None] - 2.0 * (X @ X.T) + x2[None, :]
+        return np.sqrt(np.maximum(sq, 0.0))
+    if measure_name == "cosine":
+        xn = np.sqrt(np.einsum("ij,ij->i", X, X))
+        sim = (X @ X.T) / np.maximum(np.outer(xn, xn), 1e-12)
+        return 1.0 - sim
+    if measure_name == "manhattan":
+        n = X.shape[0]
+        out = np.empty((n, n), dtype=np.float64)
+        step = max(1, (8 << 20) // max(X.size, 1))  # ~8M-element temporaries
+        for s in range(0, n, step):
+            out[s : s + step] = np.abs(X[s : s + step, None, :] - X[None, :, :]).sum(-1)
+        return out
+    return None
+
+
 def _cluster_block(X, linkage, measure, num_clusters, threshold, compute_full_tree):
     """Agglomerate one window of rows; returns (pred, merges) with
     window-local cluster ids (LocalAgglomerativeClusteringFunction.process)."""
-    import jax.numpy as jnp
-
     n = X.shape[0]
     if n == 0:
         return np.zeros(0, np.int32), []
-    dist = np.asarray(measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64)
+    dist = _pairwise_host(np.asarray(X), measure.name)
+    if dist is None:
+        import jax.numpy as jnp
+
+        dist = np.asarray(
+            measure.pairwise(jnp.asarray(X), jnp.asarray(X)), dtype=np.float64
+        )
     np.fill_diagonal(dist, np.inf)
+    native = _cluster_block_native(
+        dist, linkage, num_clusters, threshold, compute_full_tree
+    )
+    if native is not None:
+        return native
     num_active = n
     sizes = np.ones(n, dtype=np.int64)
     # fresh id for every merged cluster (n, n+1, ...) — the reference's

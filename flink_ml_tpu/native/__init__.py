@@ -27,7 +27,11 @@ _load_error: Optional[str] = None
 def _compile() -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _LIB, *_SOURCES],
+        # -ffp-contract=off: the agglomerative kernel must reproduce the
+        # numpy merge log bit for bit; FMA contraction shifts distances
+        # by 1 ulp and reorders ties
+        ["g++", "-O2", "-std=c++17", "-ffp-contract=off", "-shared", "-fPIC",
+         "-o", _LIB, *_SOURCES],
         check=True,
         capture_output=True,
     )
@@ -61,6 +65,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.fh_hash_categorical_utf32.argtypes = [p, long_, long_, p, long_, i32, p]
     lib.fh_combine.restype = None
     lib.fh_combine.argtypes = [p, p, long_, long_, p, p]
+    lib.agg_cluster.restype = long_
+    lib.agg_cluster.argtypes = [
+        p, long_, ctypes.c_int, ctypes.c_double, ctypes.c_int, long_,
+        ctypes.c_int, p, p,
+    ]
 
 
 def load() -> Optional[ctypes.CDLL]:

@@ -143,6 +143,43 @@ class TestAgglomerativeClustering:
         loaded = AgglomerativeClustering.load(str(tmp_path / "agg"))
         assert loaded.get_num_clusters() == 3
 
+    @pytest.mark.parametrize("linkage", ["ward", "single", "complete", "average"])
+    @pytest.mark.parametrize("full", [False, True])
+    def test_native_merge_loop_matches_numpy_golden(self, linkage, full, monkeypatch):
+        """The C merge loop (native/src/agglomerative.cc) must reproduce the
+        numpy loop's merge log and labels BIT for bit — same Lance-Williams
+        arithmetic, same first-minimum tie-breaking."""
+        import flink_ml_tpu.native as nat
+        from flink_ml_tpu.models.clustering import agglomerativeclustering as agg
+        from flink_ml_tpu.ops.distance import DistanceMeasure
+
+        if not nat.available():
+            pytest.skip("no native toolchain")
+        rng = np.random.RandomState(7)
+        X = rng.rand(80, 6)
+        measure = DistanceMeasure.get_instance("euclidean")
+        native = agg._cluster_block(X, linkage, measure, 5, None, full)
+        monkeypatch.setattr(agg, "_cluster_block_native", lambda *a, **k: None)
+        fallback = agg._cluster_block(X, linkage, measure, 5, None, full)
+        assert native[0].tolist() == fallback[0].tolist()
+        assert native[1] == fallback[1]
+
+    def test_native_merge_loop_threshold_matches(self, monkeypatch):
+        import flink_ml_tpu.native as nat
+        from flink_ml_tpu.models.clustering import agglomerativeclustering as agg
+        from flink_ml_tpu.ops.distance import DistanceMeasure
+
+        if not nat.available():
+            pytest.skip("no native toolchain")
+        rng = np.random.RandomState(3)
+        X = rng.rand(50, 4)
+        measure = DistanceMeasure.get_instance("euclidean")
+        native = agg._cluster_block(X, "average", measure, 1, 0.6, True)
+        monkeypatch.setattr(agg, "_cluster_block_native", lambda *a, **k: None)
+        fallback = agg._cluster_block(X, "average", measure, 1, 0.6, True)
+        assert native[0].tolist() == fallback[0].tolist()
+        assert native[1] == fallback[1]
+
 
 class TestAgglomerativeWindows:
     """HasWindows drives per-window LOCAL clustering
