@@ -27,7 +27,11 @@ from ..table import DictTokenMatrix, Table
 # different values (and float32 vs float64) across the threshold. Set
 # FLINK_ML_TPU_DEVICE_DATAGEN=0 to force the numpy path at every size when
 # cross-size seeded reproducibility matters more than ingest speed.
-DEVICE_GEN_THRESHOLD = 65_536
+# Above this row count, matrix generators birth data directly in device
+# HBM. Low on purpose: even an 8MB host-born table costs a tunnel upload
+# at fit time (~the whole warm fit wall for the 10k-row demo configs),
+# while device generation is a free async dispatch once compiled.
+DEVICE_GEN_THRESHOLD = 1_024
 
 
 def _device_gen_enabled() -> bool:
@@ -213,12 +217,12 @@ class LabeledPointWithWeightGenerator(DataGenerator):
         (names,) = self.get_col_names()
         n, d = self.get_num_values(), self.get_vector_dim()
         arity = self.get_feature_arity()
-        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+        # arity > 0 means categorical features — those feed host-based
+        # consumers (NaiveBayes theta maps), so device birth would only
+        # force the whole table back through the ~12MB/s tunnel at fit time
+        if arity == 0 and n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
             seed = self.get_seed() % (2**32)
-            if arity == 0:
-                X = _device_uniform(seed, (n, d))
-            else:
-                X = _device_randint_float(seed, (n, d), arity)
+            X = _device_uniform(seed, (n, d))
             y = _device_randint_float(seed + 1, (n,), self.get_label_arity())
             w = _device_uniform(seed + 2, (n,))
             return [Table({names[0]: X, names[1]: y, names[2]: w})]

@@ -60,7 +60,11 @@ class MaxAbsScaler(Estimator, MaxAbsScalerParams):
     def fit(self, *inputs: Table) -> MaxAbsScalerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        max_abs = jax.jit(lambda a: jnp.max(jnp.abs(a), axis=0))(jnp.asarray(X))
+        from ...utils.packing import packed_device_get
+
+        (max_abs,) = packed_device_get(
+            jax.jit(lambda a: jnp.max(jnp.abs(a), axis=0))(jnp.asarray(X))
+        )
         model = MaxAbsScalerModel()
         model.max_abs = np.asarray(max_abs, dtype=np.float64)
         update_existing_params(model, self)

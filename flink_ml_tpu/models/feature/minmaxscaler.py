@@ -101,7 +101,9 @@ class MinMaxScaler(Estimator, MinMaxScalerParams):
     def fit(self, *inputs: Table) -> MinMaxScalerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        mn, mx = _column_min_max(jnp.asarray(X))
+        from ...utils.packing import packed_device_get
+
+        mn, mx = packed_device_get(*_column_min_max(jnp.asarray(X)))
         model = MinMaxScalerModel()
         model.min_vector = np.asarray(mn, dtype=np.float64)
         model.max_vector = np.asarray(mx, dtype=np.float64)

@@ -100,8 +100,11 @@ class StandardScaler(Estimator, StandardScalerParams):
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         mean, std = _fit_stats(jnp.asarray(X))
+        from ...utils.packing import packed_device_get
+
+        host_mean, host_std = packed_device_get(mean, std)
         model = StandardScalerModel()
-        model.mean = np.asarray(mean, dtype=np.float64)
-        model.std = np.asarray(std, dtype=np.float64)
+        model.mean = np.asarray(host_mean, dtype=np.float64)
+        model.std = np.asarray(host_std, dtype=np.float64)
         update_existing_params(model, self)
         return model

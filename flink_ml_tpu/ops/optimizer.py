@@ -286,7 +286,9 @@ def read_train_result(async_result, flag=None):
     coeff, criteria, epochs, d = async_result
     if not isinstance(coeff, jax.Array):  # checkpointed host-driven path
         return (None if flag is None else float(flag)), coeff[:d], criteria, epochs
-    host = np.asarray(_pack_result(coeff, criteria, epochs, flag=flag))
+    # explicit device_get: the transfer-guard readback-budget tests run
+    # fits under jax.transfer_guard("disallow") to catch stray implicit pulls
+    host = np.asarray(jax.device_get(_pack_result(coeff, criteria, epochs, flag=flag)))
     return unpack_train_result(host, d, has_flag=flag is not None)
 
 
