@@ -217,12 +217,23 @@ class LabeledPointWithWeightGenerator(DataGenerator):
         (names,) = self.get_col_names()
         n, d = self.get_num_values(), self.get_vector_dim()
         arity = self.get_feature_arity()
-        # arity > 0 means categorical features — those feed host-based
-        # consumers (NaiveBayes theta maps), so device birth would only
-        # force the whole table back through the ~12MB/s tunnel at fit time
-        if arity == 0 and n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+        # Small categorical tables stay host-born: arity > 0 features often
+        # feed host-based consumers (NaiveBayes theta maps), and device
+        # birth would force the table back through the ~12MB/s tunnel at
+        # fit time. LARGE tables are device-born regardless — generating
+        # 1e9 ints in host numpy costs minutes on this single-core host,
+        # far worse than any readback the consumer might pay.
+        host_categorical = arity > 0 and n * d <= 20_000_000
+        if (
+            not host_categorical
+            and n >= DEVICE_GEN_THRESHOLD
+            and _device_gen_enabled()
+        ):
             seed = self.get_seed() % (2**32)
-            X = _device_uniform(seed, (n, d))
+            if arity == 0:
+                X = _device_uniform(seed, (n, d))
+            else:
+                X = _device_randint_float(seed, (n, d), arity)
             y = _device_randint_float(seed + 1, (n,), self.get_label_arity())
             w = _device_uniform(seed + 2, (n,))
             return [Table({names[0]: X, names[1]: y, names[2]: w})]

@@ -145,6 +145,14 @@ def raw_scores(col, coeff):
     return jnp.asarray(X, coeff.dtype) @ coeff
 
 
+def is_device_column(col) -> bool:
+    """True when a features column is device-resident — transforms follow
+    the device-in -> device-out convention (no forced D2H readback)."""
+    if isinstance(col, SparseBatch):
+        return isinstance(col.indices, jax.Array)
+    return isinstance(col, jax.Array)
+
+
 @jax.jit
 def _labels_ok(y):
     """Device-side {0,1} label check (LogisticRegression.java:78-87)."""

@@ -257,6 +257,36 @@ def _cluster_block(X, linkage, measure, num_clusters, threshold, compute_full_tr
 
 
 class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
+    @staticmethod
+    def _window_row_groups(table: Table, n: int, windows) -> List[np.ndarray]:
+        """Row-index groups each LOCAL clustering runs over, per window
+        descriptor. Count windows fire only when full (ragged tail
+        dropped); event-time windows read the table's 'timestamp' column
+        (ms) and fire in window-start order; a bounded table arrives at
+        one instant, so processing-time windows degenerate to one global
+        window (what a fast bounded source does in the reference)."""
+        from ...common.window import (
+            EventTimeSessionWindows,
+            EventTimeTumblingWindows,
+            ProcessingTimeSessionWindows,
+            ProcessingTimeTumblingWindows,
+        )
+        from ...utils.datastream import event_time_groups_from_table
+
+        if isinstance(windows, CountTumblingWindows):
+            size = int(windows.size)
+            n_whole = (n // size) * size
+            return [
+                np.arange(start, start + size) for start in range(0, n_whole, size)
+            ]
+        if isinstance(windows, GlobalWindows) or isinstance(
+            windows, (ProcessingTimeTumblingWindows, ProcessingTimeSessionWindows)
+        ):
+            return [np.arange(n)] if n else []
+        if isinstance(windows, (EventTimeTumblingWindows, EventTimeSessionWindows)):
+            return event_time_groups_from_table(table, windows)
+        raise ValueError(f"Unsupported windows descriptor {type(windows).__name__}")
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         linkage = self.get_linkage()
@@ -278,29 +308,16 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
         # (AgglomerativeClustering.java:122-133: windowAllAndProcess +
         # LocalAgglomerativeClusteringFunction per window).
         windows = self.get_windows()
-        if isinstance(windows, CountTumblingWindows):
-            size = int(windows.size)
-            # Flink count windows fire only when full: the ragged tail is
-            # dropped, so the output covers floor(n/size)*size rows
-            n_whole = (X.shape[0] // size) * size
-            starts = list(range(0, n_whole, size))
-            kept_rows = np.arange(n_whole)
-        elif isinstance(windows, GlobalWindows):
-            starts = [0] if X.shape[0] else []
-            size = X.shape[0]
-            kept_rows = np.arange(X.shape[0])
-        else:
-            raise NotImplementedError(
-                f"{type(windows).__name__} needs event-/processing-time "
-                "semantics; bounded tables support GlobalWindows and "
-                "CountTumblingWindows (use the online runtime for time "
-                "windows)"
-            )
+        groups = self._window_row_groups(table, X.shape[0], windows)
+        kept_rows = (
+            np.concatenate(groups) if groups else np.zeros(0, np.int64)
+        )
         n_total = len(kept_rows)
         preds, all_merges = [], []
-        for start in starts:
+        offset = 0
+        for group in groups:
             pred, merges = _cluster_block(
-                X[start : start + size],
+                X[group],
                 linkage,
                 measure,
                 num_clusters,
@@ -310,24 +327,31 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
             preds.append(pred)
             # remap window-local cluster ids to global ones so the
             # concatenated merge log stays decodable: local row id i ->
-            # global row start+i; local merged id local_n+j (the window's
-            # j-th merge) -> n_total + (merges logged so far) + j — the
-            # same "rows first, then merges in log order" convention the
-            # single-window output uses
+            # output row offset+i (rows are emitted in window order);
+            # local merged id local_n+j (the window's j-th merge) ->
+            # n_total + (merges logged so far) + j — the same "rows first,
+            # then merges in log order" convention the single-window
+            # output uses
             local_n = len(pred)
             merge_base = n_total + len(all_merges)
 
-            def remap(cid, start=start, local_n=local_n, merge_base=merge_base):
+            def remap(cid, offset=offset, local_n=local_n, merge_base=merge_base):
                 if cid < local_n:
-                    return cid + start
+                    return cid + offset
                 return merge_base + (cid - local_n)
 
             all_merges.extend(
                 (remap(a), remap(b), dist_, size_) for a, b, dist_, size_ in merges
             )
+            offset += local_n
         pred = np.concatenate(preds) if preds else np.zeros(0, np.int32)
         out = table
-        if len(kept_rows) != table.num_rows:
+        # reorder/select whenever kept_rows is not the identity — event-time
+        # groups can be a full-cover PERMUTATION (unsorted timestamps), where
+        # a length check alone would leave predictions attached to the wrong rows
+        if len(kept_rows) != table.num_rows or not np.array_equal(
+            kept_rows, np.arange(table.num_rows)
+        ):
             out = out.take(kept_rows)
         out = out.with_column(self.get_prediction_col(), pred)
         merge_table = Table(

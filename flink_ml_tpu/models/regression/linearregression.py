@@ -73,9 +73,11 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
         from .. import _linear
 
         pred = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
-        return [
-            table.with_column(self.get_prediction_col(), np.asarray(pred, dtype=np.float64))
-        ]
+        # device in -> device out (the LR/SVC convention): materializing
+        # here would pull the whole prediction vector through the tunnel
+        if not _linear.is_device_column(col):
+            pred = np.asarray(pred, dtype=np.float64)
+        return [table.with_column(self.get_prediction_col(), pred)]
 
     def _save_extra(self, path: str) -> None:
         read_write.save_model_arrays(path, coefficient=self.coefficient)

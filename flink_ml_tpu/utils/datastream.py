@@ -23,6 +23,8 @@ R = TypeVar("R")
 
 __all__ = [
     "aggregate",
+    "event_time_window_groups",
+    "event_time_groups_from_table",
     "map_partition",
     "reduce",
     "sample",
@@ -36,6 +38,41 @@ def iter_batches(data: Union[Table, StreamTable]) -> Iterable[Table]:
     if isinstance(data, Table):
         return [data]
     return data
+
+
+def _concat_all(tables: List[Table]) -> Table:
+    """Concatenate batches linearly: one np.concatenate per plain ndarray
+    column; anything fancier (sparse, token, mixed dtypes) folds through
+    Table.concat. The pairwise fold alone is O(B^2) row copying."""
+    if len(tables) == 1:
+        return tables[0]
+    cols = {}
+    for name in tables[0].column_names:
+        parts = [t.column(name) for t in tables]
+        if not all(
+            isinstance(x, np.ndarray) and x.dtype == parts[0].dtype for x in parts
+        ):
+            break
+        cols[name] = np.concatenate(parts)
+    else:
+        if all(t.column_names == tables[0].column_names for t in tables):
+            return Table(cols)
+    out = tables[0]
+    for b in tables[1:]:
+        out = out.concat(b)
+    return out
+
+
+def event_time_groups_from_table(table: Table, windows, timestamp_col: str = "timestamp"):
+    """Validate the timestamp column and return event-time row groups —
+    the single entry point both window_all_and_process and windowed stages
+    (AgglomerativeClustering) share."""
+    if timestamp_col not in table.column_names:
+        raise ValueError(
+            f"Event-time windows need a {timestamp_col!r} column carrying "
+            "each record's event time in milliseconds"
+        )
+    return event_time_window_groups(np.asarray(table.column(timestamp_col)), windows)
 
 
 def aggregate(
@@ -133,10 +170,48 @@ def reduce(
     return acc
 
 
+def event_time_window_groups(
+    timestamps: np.ndarray, windows
+) -> List[np.ndarray]:
+    """Row-index groups for event-time window descriptors over a bounded
+    input, in firing (window-start / session-start) order.
+
+    Tumbling (TumblingEventTimeWindows.assignWindows): a record at time t
+    belongs to the window starting at ``t - (t % size)`` (epoch-aligned).
+    Session (EventTimeSessionWindows): windows merge while consecutive
+    event times are within ``gap`` of each other."""
+    from ..common.window import EventTimeSessionWindows, EventTimeTumblingWindows
+
+    ts = np.asarray(timestamps, dtype=np.int64)
+    if isinstance(windows, EventTimeTumblingWindows):
+        size = int(windows.size_ms)
+        if size <= 0:
+            raise ValueError("Event-time tumbling window size must be positive")
+        # numpy % is floorMod, so this floor-aligns negatives correctly too
+        starts = ts - (ts % size)
+        order = np.argsort(starts, kind="stable")
+        uniq, first = np.unique(starts[order], return_index=True)
+        bounds = list(first) + [len(order)]
+        return [order[bounds[i] : bounds[i + 1]] for i in range(len(uniq))]
+    if isinstance(windows, EventTimeSessionWindows):
+        gap = int(windows.gap_ms)
+        if gap <= 0:
+            raise ValueError("Session gap must be positive")
+        order = np.argsort(ts, kind="stable")
+        if order.size == 0:
+            return []
+        sorted_ts = ts[order]
+        breaks = np.nonzero(np.diff(sorted_ts) > gap)[0] + 1
+        return [np.sort(g) for g in np.split(order, breaks)]
+    raise TypeError(f"Not an event-time descriptor: {type(windows).__name__}")
+
+
 def window_all_and_process(
     data: Union[Table, StreamTable],
     windows,
     fn: Callable[[Table], Table],
+    timestamp_col: str = "timestamp",
+    clock: Optional[Callable[[], float]] = None,
 ) -> Union[Table, StreamTable]:
     """Re-chunk the input by a window descriptor and apply `fn` per window
     (DataStreamUtils.windowAllAndProcess, :262 — the mechanism behind
@@ -147,9 +222,91 @@ def window_all_and_process(
     endOfStreamWindows behaviour — a StreamTable is materialized, so pass
     bounded streams only); CountTumblingWindows(k) = windows of exactly k rows —
     Flink count windows only fire when FULL, so the ragged tail is
-    dropped. Time windows need the online runtime's timestamp handling
-    and are rejected here."""
-    from ..common.window import CountTumblingWindows, GlobalWindows
+    dropped.
+
+    Event-time windows read each record's event time (ms) from
+    ``timestamp_col`` — the bounded analogue of Flink's stream timestamps;
+    windows fire in window-start order once the bounded input ends
+    (watermark -> +inf). Processing-time windows stamp each incoming BATCH
+    with the wall clock (``clock``, default time.monotonic, in seconds;
+    injectable for deterministic tests) and fire a window when a batch
+    arrives past its boundary — a bounded Table arrives all at once and is
+    one window, matching what a fast bounded source degenerates to in the
+    reference."""
+    import time as _time
+
+    from ..common.window import (
+        CountTumblingWindows,
+        EventTimeSessionWindows,
+        EventTimeTumblingWindows,
+        GlobalWindows,
+        ProcessingTimeSessionWindows,
+        ProcessingTimeTumblingWindows,
+    )
+
+    if isinstance(windows, (EventTimeTumblingWindows, EventTimeSessionWindows)):
+        batches = list(iter_batches(data))
+        if not batches:
+            return StreamTable([]) if isinstance(data, StreamTable) else Table({})
+        whole = _concat_all(batches)
+        groups = event_time_groups_from_table(whole, windows, timestamp_col)
+        results = [fn(whole.take(g)) for g in groups]
+        if isinstance(data, StreamTable):
+            return StreamTable(results)
+        if not results:
+            return Table({})
+        out = results[0]
+        for r in results[1:]:
+            out = out.concat(r)
+        return out
+
+    if isinstance(
+        windows, (ProcessingTimeTumblingWindows, ProcessingTimeSessionWindows)
+    ):
+        if isinstance(data, Table):
+            # a bounded table "arrives" at one instant: one window
+            return fn(data)
+        clock = clock or _time.monotonic
+        if isinstance(windows, ProcessingTimeTumblingWindows):
+            size_s = int(windows.size_ms) / 1000.0
+            if size_s <= 0:
+                raise ValueError("Processing-time window size must be positive")
+
+            def proc_chunks() -> Iterable[Table]:
+                pending: List[Table] = []
+                window_end: Optional[float] = None
+                for batch in data:
+                    now = clock()
+                    if window_end is None:
+                        window_end = (now // size_s + 1) * size_s
+                    elif now >= window_end:
+                        if pending:
+                            yield _concat_all(pending)
+                        pending = []
+                        window_end = (now // size_s + 1) * size_s
+                    pending.append(batch)
+                if pending:
+                    yield _concat_all(pending)
+
+            return StreamTable(fn(w) for w in proc_chunks())
+        gap_s = int(windows.gap_ms) / 1000.0
+        if gap_s <= 0:
+            raise ValueError("Session gap must be positive")
+
+        def session_chunks() -> Iterable[Table]:
+            pending: List[Table] = []
+            last: Optional[float] = None
+            for batch in data:
+                now = clock()
+                if last is not None and now - last > gap_s and pending:
+                    yield _concat_all(pending)
+                    pending = []
+                pending.append(batch)
+                last = now
+            if pending:
+                yield _concat_all(pending)
+
+        return StreamTable(fn(w) for w in session_chunks())
 
     if isinstance(windows, GlobalWindows):
         # ONE window over the whole BOUNDED input (endOfStreamWindows):
@@ -160,9 +317,7 @@ def window_all_and_process(
         batches = list(iter_batches(data))
         if not batches:
             return StreamTable([]) if isinstance(data, StreamTable) else Table({})
-        whole = batches[0]
-        for b in batches[1:]:
-            whole = whole.concat(b)
+        whole = _concat_all(batches)
         result = fn(whole)
         return StreamTable([result]) if isinstance(data, StreamTable) else result
     if isinstance(windows, CountTumblingWindows):
@@ -178,9 +333,7 @@ def window_all_and_process(
                 pending.append(batch)
                 pending_rows += batch.num_rows
                 while pending_rows >= size:
-                    merged = pending[0]
-                    for b in pending[1:]:
-                        merged = merged.concat(b)
+                    merged = _concat_all(pending)
                     off = 0
                     while merged.num_rows - off >= size:
                         yield merged.take(np.arange(off, off + size))

@@ -229,13 +229,15 @@ class TestAgglomerativeWindows:
         )
         assert out.num_rows == 10
 
-    def test_time_windows_rejected(self):
+    def test_event_time_windows_need_timestamp_column(self):
+        """Event-time windows are supported (tests/test_time_windows.py)
+        but require a 'timestamp' column; a clear error names it."""
         from flink_ml_tpu.common.window import EventTimeTumblingWindows
         from flink_ml_tpu.models.clustering.agglomerativeclustering import (
             AgglomerativeClustering,
         )
 
-        with pytest.raises(NotImplementedError, match="time"):
+        with pytest.raises(ValueError, match="timestamp"):
             AgglomerativeClustering().set_windows(
                 EventTimeTumblingWindows.of(1000)
             ).transform(self._table())

@@ -1,0 +1,197 @@
+"""Event-/processing-time window semantics (VERDICT r4 missing #2).
+
+The reference windows on event/processing time throughout
+(common/window/EventTimeTumblingWindows.java, consumed via HasWindows in
+AgglomerativeClustering.java). Bounded analogue here: event-time windows
+read each record's event time (ms) from a 'timestamp' column; processing-
+time windows stamp batch arrival with an injectable clock."""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.common.window import (
+    CountTumblingWindows,
+    EventTimeSessionWindows,
+    EventTimeTumblingWindows,
+    ProcessingTimeSessionWindows,
+    ProcessingTimeTumblingWindows,
+)
+from flink_ml_tpu.models.clustering.agglomerativeclustering import (
+    AgglomerativeClustering,
+)
+from flink_ml_tpu.table import StreamTable, Table
+from flink_ml_tpu.utils.datastream import (
+    event_time_window_groups,
+    window_all_and_process,
+)
+
+
+class TestEventTimeGroups:
+    def test_tumbling_assignment_epoch_aligned(self):
+        ts = np.array([0, 5, 10, 14, 20, 999])
+        groups = event_time_window_groups(ts, EventTimeTumblingWindows.of(10))
+        assert [g.tolist() for g in groups] == [[0, 1], [2, 3], [4], [5]][:3] + [[5]]
+
+    def test_tumbling_negative_timestamps(self):
+        # floor alignment: t=-1 belongs to window [-10, 0)
+        ts = np.array([-1, -10, 1])
+        groups = event_time_window_groups(ts, EventTimeTumblingWindows.of(10))
+        assert [sorted(g.tolist()) for g in groups] == [[0, 1], [2]]
+
+    def test_session_gap_merging(self):
+        ts = np.array([0, 50, 300, 320, 1000])
+        groups = event_time_window_groups(ts, EventTimeSessionWindows.with_gap(100))
+        assert [g.tolist() for g in groups] == [[0, 1], [2, 3], [4]]
+
+    def test_unsorted_input_rows(self):
+        ts = np.array([320, 0, 1000, 50, 300])
+        groups = event_time_window_groups(ts, EventTimeSessionWindows.with_gap(100))
+        assert [sorted(g.tolist()) for g in groups] == [[1, 3], [0, 4], [2]]
+
+
+class TestWindowAllAndProcess:
+    def _table(self):
+        return Table(
+            {
+                "x": np.arange(6, dtype=np.float64),
+                "timestamp": np.array([0, 5, 10, 15, 20, 25]),
+            }
+        )
+
+    def test_event_tumbling_window_size_changes_output(self):
+        counts = lambda w: Table({"n": np.array([w.num_rows])})
+        out10 = window_all_and_process(self._table(), EventTimeTumblingWindows.of(10), counts)
+        out30 = window_all_and_process(self._table(), EventTimeTumblingWindows.of(30), counts)
+        assert np.asarray(out10.column("n")).tolist() == [2, 2, 2]
+        assert np.asarray(out30.column("n")).tolist() == [6]
+
+    def test_event_windows_require_timestamp_column(self):
+        with pytest.raises(ValueError, match="timestamp"):
+            window_all_and_process(
+                Table({"x": np.arange(3)}), EventTimeTumblingWindows.of(10), lambda w: w
+            )
+
+    def test_event_session_on_stream(self):
+        batches = [
+            Table({"x": np.array([1.0]), "timestamp": np.array([0])}),
+            Table({"x": np.array([2.0]), "timestamp": np.array([5])}),
+            Table({"x": np.array([3.0]), "timestamp": np.array([500])}),
+        ]
+        out = window_all_and_process(
+            StreamTable.from_batches(batches),
+            EventTimeSessionWindows.with_gap(100),
+            lambda w: Table({"n": np.array([w.num_rows])}),
+        )
+        assert [int(np.asarray(t.column("n"))[0]) for t in out] == [2, 1]
+
+    def test_processing_tumbling_with_fake_clock(self):
+        # batches arrive at t=0.0, 0.1, 5.0, 5.1 -> two windows of two batches
+        times = iter([0.0, 0.1, 5.0, 5.1])
+        batches = [Table({"x": np.array([float(i)])}) for i in range(4)]
+        out = window_all_and_process(
+            StreamTable.from_batches(batches),
+            ProcessingTimeTumblingWindows.of(1000),
+            lambda w: Table({"n": np.array([w.num_rows])}),
+            clock=lambda: next(times),
+        )
+        assert [int(np.asarray(t.column("n"))[0]) for t in out] == [2, 2]
+
+    def test_processing_session_with_fake_clock(self):
+        times = iter([0.0, 0.05, 10.0])
+        batches = [Table({"x": np.array([float(i)])}) for i in range(3)]
+        out = window_all_and_process(
+            StreamTable.from_batches(batches),
+            ProcessingTimeSessionWindows.with_gap(1000),
+            lambda w: Table({"n": np.array([w.num_rows])}),
+            clock=lambda: next(times),
+        )
+        assert [int(np.asarray(t.column("n"))[0]) for t in out] == [2, 1]
+
+    def test_processing_time_bounded_table_is_one_window(self):
+        out = window_all_and_process(
+            Table({"x": np.arange(4, dtype=np.float64)}),
+            ProcessingTimeTumblingWindows.of(10),
+            lambda w: Table({"n": np.array([w.num_rows])}),
+        )
+        assert np.asarray(out.column("n")).tolist() == [4]
+
+
+class TestAgglomerativeTimeWindows:
+    """Changing the time window must change the clustering output —
+    reference semantics: each window clusters LOCALLY."""
+
+    def _table(self):
+        rng = np.random.RandomState(0)
+        # 3 time groups of 4 rows; rows within a group are two tight pairs
+        X = rng.rand(12, 2) * 0.01
+        X[::2] += 5.0  # every other row in a far blob
+        ts = np.repeat([0, 1000, 2000], 4)
+        return Table({"features": X, "timestamp": ts})
+
+    def test_event_tumbling_size_changes_prediction(self):
+        op = AgglomerativeClustering().set_num_clusters(2)
+        small = op.set_windows(EventTimeTumblingWindows.of(500))
+        out_small, merges_small = small.transform(self._table())
+        # 3 windows x 4 rows, each clustered locally into 2 clusters
+        assert merges_small.num_rows == 3 * 2
+        big = op.set_windows(EventTimeTumblingWindows.of(5000))
+        out_big, merges_big = big.transform(self._table())
+        assert merges_big.num_rows == 10  # one window of 12 rows -> 10 merges
+        assert merges_small.num_rows != merges_big.num_rows
+
+    def test_event_session_windows(self):
+        op = (
+            AgglomerativeClustering()
+            .set_num_clusters(2)
+            .set_windows(EventTimeSessionWindows.with_gap(500))
+        )
+        _, merges = op.transform(self._table())
+        assert merges.num_rows == 3 * 2  # gaps of 1000ms split 3 sessions
+
+    def test_event_windows_need_timestamp(self):
+        op = AgglomerativeClustering().set_windows(EventTimeTumblingWindows.of(10))
+        with pytest.raises(ValueError, match="timestamp"):
+            op.transform(Table({"features": np.random.rand(4, 2)}))
+
+    def test_processing_time_bounded_degenerates_to_global(self):
+        op = AgglomerativeClustering().set_num_clusters(2).set_windows(
+            ProcessingTimeTumblingWindows.of(1000)
+        )
+        out, merges = op.transform(self._table())
+        assert merges.num_rows == 10
+        assert len(set(np.asarray(out.column("prediction")).tolist())) == 2
+
+    def test_unsorted_timestamps_keep_rows_aligned(self):
+        """Interleaved timestamps make kept_rows a full-cover PERMUTATION;
+        predictions and merge-log row ids must follow the reordered output
+        rows, not the input order (review finding: a length-only check
+        skipped the reorder)."""
+        X = np.array([[100.0, 100.0], [0.0, 0.0], [101.0, 101.0], [1.0, 1.0]])
+        ts = np.array([1000, 0, 1000, 0])
+        out, merges = (
+            AgglomerativeClustering()
+            .set_num_clusters(1)
+            .set_windows(EventTimeTumblingWindows.of(500))
+            .transform(Table({"features": X, "timestamp": ts}))
+        )
+        feats = np.asarray(out.column("features"))
+        # output rows come in window order: ts=0 rows first
+        np.testing.assert_array_equal(feats[:2], X[[1, 3]])
+        # each window's single merge joins that window's two OUTPUT rows
+        ids = set()
+        for r in range(merges.num_rows):
+            ids.add((int(merges.collect()[r]["clusterId1"]),
+                     int(merges.collect()[r]["clusterId2"])))
+        assert ids == {(0, 1), (2, 3)}
+        # merged pairs really are the near rows (distance ~1.4, not ~141)
+        dists = [float(row["distance"]) for row in merges.collect()]
+        assert all(d < 5.0 for d in dists), dists
+
+    def test_count_windows_unchanged(self):
+        op = AgglomerativeClustering().set_num_clusters(2).set_windows(
+            CountTumblingWindows.of(5)
+        )
+        out, merges = op.transform(self._table())
+        # 12 rows -> 2 full windows of 5, tail of 2 dropped
+        assert out.num_rows == 10
+        assert merges.num_rows == 2 * 3
