@@ -82,17 +82,23 @@ class KnnModel(Model, KnnModelParams):
         return [Table({"features": self.features, "labels": self.labels})]
 
     def transform(self, *inputs: Table) -> List[Table]:
-        from ...utils.packing import packed_device_get
-
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
         k = min(self.get_k(), self.features.shape[0])
         idx_dev = _top_k_indices(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.features, jnp.float32), k
         )
-        # one packed readback: neighbor indices + (possibly device) labels
-        idx, labels = packed_device_get(idx_dev, self.labels)
-        neighbor_labels = np.asarray(labels, dtype=np.float64)[idx]
+        # single readback either way; never pack int32 indices with float
+        # labels (float32 promotion corrupts indices above 2**24)
+        if is_device_column(self.labels):
+            neighbor_labels = np.asarray(
+                jax.jit(lambda lab, i: lab[i])(jnp.asarray(self.labels), idx_dev),
+                dtype=np.float64,
+            )
+        else:
+            neighbor_labels = np.asarray(self.labels, dtype=np.float64)[
+                np.asarray(idx_dev)
+            ]
         pred = _majority_vote(neighbor_labels)
         return [table.with_column(self.get_prediction_col(), pred)]
 

@@ -348,10 +348,9 @@ class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
         out = table
         # reorder/select whenever kept_rows is not the identity — event-time
         # groups can be a full-cover PERMUTATION (unsorted timestamps), where
-        # a length check alone would leave predictions attached to the wrong rows
-        if len(kept_rows) != table.num_rows or not np.array_equal(
-            kept_rows, np.arange(table.num_rows)
-        ):
+        # a length check alone would leave predictions attached to the wrong
+        # rows (array_equal also covers the shorter-selection case)
+        if not np.array_equal(kept_rows, np.arange(table.num_rows)):
             out = out.take(kept_rows)
         out = out.with_column(self.get_prediction_col(), pred)
         merge_table = Table(

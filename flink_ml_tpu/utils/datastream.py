@@ -255,22 +255,26 @@ def window_all_and_process(
             return StreamTable(results)
         if not results:
             return Table({})
-        out = results[0]
-        for r in results[1:]:
-            out = out.concat(r)
-        return out
+        return _concat_all(results)
 
     if isinstance(
         windows, (ProcessingTimeTumblingWindows, ProcessingTimeSessionWindows)
     ):
+        # validate before the bounded-Table fast path: an invalid descriptor
+        # must fail regardless of input type
+        if isinstance(windows, ProcessingTimeTumblingWindows):
+            size_s = int(windows.size_ms) / 1000.0
+            if size_s <= 0:
+                raise ValueError("Processing-time window size must be positive")
+        else:
+            gap_s = int(windows.gap_ms) / 1000.0
+            if gap_s <= 0:
+                raise ValueError("Session gap must be positive")
         if isinstance(data, Table):
             # a bounded table "arrives" at one instant: one window
             return fn(data)
         clock = clock or _time.monotonic
         if isinstance(windows, ProcessingTimeTumblingWindows):
-            size_s = int(windows.size_ms) / 1000.0
-            if size_s <= 0:
-                raise ValueError("Processing-time window size must be positive")
 
             def proc_chunks() -> Iterable[Table]:
                 pending: List[Table] = []
@@ -289,9 +293,6 @@ def window_all_and_process(
                     yield _concat_all(pending)
 
             return StreamTable(fn(w) for w in proc_chunks())
-        gap_s = int(windows.gap_ms) / 1000.0
-        if gap_s <= 0:
-            raise ValueError("Session gap must be positive")
 
         def session_chunks() -> Iterable[Table]:
             pending: List[Table] = []
