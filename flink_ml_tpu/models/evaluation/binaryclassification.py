@@ -121,7 +121,15 @@ def _binary_metrics_device(scores, labels, weights):
     group, found by gathering at (start_of_group - 1). Scoring 10M rows is
     then a device sort + cumsums instead of a host argsort
     (BinaryClassificationEvaluator.java:99-198 distributes across score
-    ranges for the same reason)."""
+    ranges for the same reason).
+
+    Precision: with x64 off everything runs in float32 — score ties that
+    differ only below float32 resolution merge into one threshold group,
+    and the cumsums carry float32 error (XLA's prefix sum is an
+    associative scan, so the error grows ~log n, not n). The documented
+    deviation bound vs the float64 oracle is 1e-3 absolute at 500k rows
+    with heavy ties (pinned by the large-n parity test); enable
+    jax_enable_x64 for double-precision parity with the reference."""
     n = scores.shape[0]
     f = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     order = jnp.argsort(-scores, stable=True)

@@ -38,13 +38,15 @@ class NGram(Transformer, NGramParams):
                 out = np.empty(len(col), dtype=object)
                 out[:] = [[] for _ in range(len(col))]
                 return [table.with_column(self.get_output_col(), out)]
-            if u**n <= 4_000_000:
-                # dictionary path: gram codes on device, gram vocab = the
-                # u^n joined combinations built once on host
+            if u**n < 2**31:
+                # dictionary path: gram codes on device (int32-exact up to
+                # the 2^31 code space), gram vocab decoded lazily for the
+                # distinct codes actually observed — the combinatorial u^n
+                # space never materializes
                 from ...ops import tokens as tokens_ops
 
                 codes = tokens_ops.ngram_codes(col.ids, u, n)
-                vocab = tokens_ops.ngram_vocab(col.vocab, n)
+                vocab, codes = tokens_ops.ngram_vocab_observed(col.vocab, n, codes)
                 return [
                     table.with_column(
                         self.get_output_col(), DictTokenMatrix(vocab, codes)

@@ -2,10 +2,12 @@
 
 TPU-native re-design of feature/sqltransformer/SQLTransformer.java:193 (the
 reference executes `SELECT ... FROM __THIS__` through the Flink Table API).
-Without a streaming SQL engine, scalar columns are evaluated through an
-in-memory sqlite3 database (stdlib), which covers the SELECT / WHERE /
-GROUP BY / aggregate subset the reference's docs demonstrate. Vector and
-array columns pass through only when selected verbatim via `*`.
+Without a streaming SQL engine, projections and WHERE filters evaluate
+columnwise (including arithmetic over vector columns, which SQL engines
+cannot represent); everything else — GROUP BY, aggregates, joins of scalar
+columns — runs through an in-memory sqlite3 database (stdlib), covering
+the subset the reference's docs demonstrate, with vector columns passed
+through by row identity on star selects.
 """
 
 from __future__ import annotations
@@ -117,7 +119,10 @@ class SQLTransformer(Transformer):
 # sqlite (all NULL there): float column division by zero yields inf/nan,
 # and out-of-domain SQRT/LN/LOG10 yield nan/-inf (IEEE semantics, which
 # the reference's Flink SQL also uses for DOUBLE). Integer columns bail
-# to sqlite so its integer-division semantics are preserved.
+# to sqlite so its integer-division semantics are preserved. WHERE
+# comparisons, by contrast, DO follow SQL NULL semantics for NaN (a NaN
+# operand is "unknown", the row is dropped, NOT/AND/OR propagate per
+# Kleene) so filtered row membership matches the sqlite path exactly.
 
 _FUNCS = frozenset({"abs", "sqrt", "exp", "ln", "log10", "sin", "cos"})
 
@@ -138,7 +143,7 @@ def _apply_func(name: str, arg):
 
 _TOKEN = re.compile(
     r"\s*(?:(?P<num>\d+\.\d*|\.\d+|\d+)|(?P<name>[A-Za-z_][A-Za-z_0-9]*)"
-    r"|(?P<op>[-+*/()]))"
+    r"|(?P<op><=|>=|<>|!=|[-+*/()<>=]))"
 )
 
 
@@ -176,6 +181,82 @@ class _ExprParser:
         if self.i != len(self.tokens):
             raise ValueError("trailing tokens")
         return value
+
+    # --- boolean layer (WHERE clauses): OR < AND < NOT < comparison --------
+    #
+    # SQL three-valued (Kleene) logic: each boolean node evaluates to a
+    # (true_mask, false_mask) pair; a NaN operand (sqlite stores NaN as
+    # NULL, and NULL comparisons yield NULL) makes a row neither true nor
+    # false, NOT/AND/OR propagate the unknown, and only definitely-true
+    # rows survive the filter — matching what the sqlite path returns for
+    # the same statement.
+
+    def parse_where(self):
+        true_mask, _ = self.bool_or()
+        if self.i != len(self.tokens):
+            raise ValueError("trailing tokens")
+        return true_mask
+
+    def _is_kw(self, word: str) -> bool:
+        kind, text = self.peek()
+        return kind == "name" and text.lower() == word
+
+    def bool_or(self):
+        t, f = self.bool_and()
+        while self._is_kw("or"):
+            self.take()
+            t2, f2 = self.bool_and()
+            t, f = t | t2, f & f2
+        return t, f
+
+    def bool_and(self):
+        t, f = self.bool_not()
+        while self._is_kw("and"):
+            self.take()
+            t2, f2 = self.bool_not()
+            t, f = t & t2, f | f2
+        return t, f
+
+    def bool_not(self):
+        if self._is_kw("not"):
+            self.take()
+            t, f = self.bool_not()
+            return f, t
+        if self.peek() == ("op", "("):
+            # "(" may open a boolean group OR an arithmetic subexpression
+            # ("(a + 1) > 2"); try boolean first, backtrack on failure
+            mark = self.i
+            try:
+                self.take()
+                value = self.bool_or()
+                if self.take() != ("op", ")"):
+                    raise ValueError("unbalanced parens")
+                return value
+            except ValueError:
+                self.i = mark
+        return self.comparison()
+
+    def comparison(self):
+        lhs = self.add()
+        kind, text = self.peek()
+        if kind == "op" and text in ("<", ">", "<=", ">=", "=", "!=", "<>"):
+            self.take()
+            rhs = self.add()
+            known = ~(np.isnan(lhs) | np.isnan(rhs))
+            if text == "=":
+                cmp = lhs == rhs
+            elif text in ("!=", "<>"):
+                cmp = lhs != rhs
+            elif text == "<":
+                cmp = lhs < rhs
+            elif text == ">":
+                cmp = lhs > rhs
+            elif text == "<=":
+                cmp = lhs <= rhs
+            else:
+                cmp = lhs >= rhs
+            return cmp & known, ~cmp & known
+        raise ValueError("WHERE term must be a comparison")
 
     def add(self):
         value = self.mul()
@@ -255,11 +336,28 @@ def _split_select_items(select_list: str) -> List[str]:
 
 
 def _try_vectorized_projection(statement: str, table: Table):
-    """Evaluate `SELECT items FROM __THIS__` columnwise; None = not a pure
-    projection (caller falls back to sqlite)."""
-    m = re.match(r"(?is)^\s*select\s+(.*?)\s+from\s+__THIS__\s*;?\s*$", statement)
+    """Evaluate `SELECT items FROM __THIS__ [WHERE cond]` columnwise; None =
+    not expressible (caller falls back to sqlite). The WHERE condition is a
+    boolean combination (AND/OR/NOT) of comparisons over scalar float
+    columns, evaluated as one columnwise mask — this keeps vector columns
+    alive through filtered selects, which the sqlite path cannot represent
+    (SQLTransformer.java:193 runs them through the Table API natively)."""
+    m = re.match(
+        r"(?is)^\s*select\s+(.*?)\s+from\s+__THIS__(?:\s+where\s+(.*?))?\s*;?\s*$",
+        statement,
+    )
     if m is None:
         return None
+    where = m.group(2)
+    mask = None
+    if where is not None:
+        try:
+            mask = _ExprParser(_tokenize(where), table).parse_where()
+        except (ValueError, KeyError, IndexError, TypeError):
+            return None
+        mask = np.asarray(mask)
+        if mask.dtype != np.bool_ or mask.shape != (table.num_rows,):
+            return None  # e.g. a comparison over a (n, d) vector column
     out = {}
     for item in _split_select_items(m.group(1)):
         if item == "*":
@@ -283,4 +381,7 @@ def _try_vectorized_projection(statement: str, table: Table):
         if np.ndim(value) == 0:  # constant: broadcast to column
             value = np.full(table.num_rows, float(value))
         out[alias] = value
-    return Table(out)
+    result = Table(out)
+    if mask is not None:
+        result = result.take(np.flatnonzero(mask))
+    return result

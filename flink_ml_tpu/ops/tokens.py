@@ -45,7 +45,9 @@ def term_counts(ids, num_terms):
     df = jnp.bincount(
         jnp.where(first, S, num_terms).ravel(), length=num_terms + 1
     )[:num_terms]
-    return jnp.stack([tf, df]).astype(jnp.int64)
+    # int32 on purpose: with x64 off an int64 cast silently truncates
+    # anyway; counts are bounded by the corpus token count (< 2^31)
+    return jnp.stack([tf, df]).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("binary",))
@@ -96,7 +98,7 @@ def _term_counts_dense(ids, num_terms):
     eq = ids[:, :, None] == jnp.arange(num_terms, dtype=ids.dtype)[None, None, :]
     tf = jnp.sum(eq, axis=(0, 1))
     df = jnp.sum(jnp.any(eq, axis=1), axis=0)
-    return jnp.stack([tf, df]).astype(jnp.int64)
+    return jnp.stack([tf, df]).astype(jnp.int32)  # see term_counts
 
 
 def term_counts_chunked(ids, num_terms, chunk_rows: int = CHUNK_ROWS):
@@ -256,7 +258,7 @@ def ngram_codes(ids, num_terms, gram):
     give an empty array)."""
     n, k = ids.shape
     out_k = k - gram + 1
-    # int32 is exact here: callers guard num_terms**gram <= 4e6 << 2^31
+    # int32 is exact here: callers guard num_terms**gram < 2^31
     code = jnp.zeros((n, out_k), jnp.int32)
     valid = jnp.ones((n, out_k), jnp.bool_)
     for t in range(gram):
@@ -266,17 +268,32 @@ def ngram_codes(ids, num_terms, gram):
     return jnp.where(valid, code, -1)
 
 
-def ngram_vocab(vocab: np.ndarray, gram: int) -> np.ndarray:
-    """Host-side n-gram vocabulary in code order: entry for code c is the
-    space-joined terms of c's base-u digits. Size u^gram — callers guard
-    against explosion before calling."""
+def ngram_vocab_observed(vocab: np.ndarray, gram: int, codes):
+    """N-gram vocabulary restricted to the codes actually observed, plus the
+    code matrix reindexed to it. Returns (gram_vocab, remapped_ids).
+
+    Decoding every u^gram combination is O(u^gram) host strings (hundreds
+    of MB near the code-space limit) while real corpora touch a tiny
+    fraction of the combinatorial space; here the distinct codes are found
+    on device (one (m,) readback, m = distinct observed grams) and only
+    those decode to space-joined strings. -1 (absent) is preserved."""
     u = len(vocab)
-    grams = vocab.astype(object)
-    for _ in range(gram - 1):
-        grams = np.char.add(np.char.add(grams[:, None].astype(str), " "), vocab[None, :].astype(str)).ravel()
-        grams = grams.astype(object)
-    width = (np.char.str_len(vocab.astype(str)).max() + 1) * gram
-    return grams.astype(f"<U{width}")
+    uniq_host = np.asarray(jnp.unique(codes.ravel()))
+    uniq_host = uniq_host[uniq_host >= 0]
+    # reindex codes to compact ranks on device (searchsorted over the
+    # sorted distinct codes); -1 sentinel passes through
+    uniq_dev = jnp.asarray(uniq_host, jnp.int32)
+    ranks = jnp.searchsorted(uniq_dev, codes)
+    remapped = jnp.where(codes >= 0, ranks.astype(jnp.int32), jnp.int32(-1))
+    if uniq_host.size == 0:
+        return np.zeros(0, dtype="<U1"), remapped
+    powers = u ** np.arange(gram - 1, -1, -1, dtype=np.int64)
+    digits = (uniq_host[:, None].astype(np.int64) // powers) % u  # (m, gram)
+    terms = vocab.astype(str)[digits]
+    joined = terms[:, 0]
+    for t in range(1, gram):
+        joined = np.char.add(np.char.add(joined, " "), terms[:, t])
+    return joined, remapped
 
 
 def random_token_ids(seed: int, n: int, k: int, num_terms: int):

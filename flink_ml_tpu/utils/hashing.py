@@ -115,7 +115,25 @@ def murmur3_batch_unencoded_chars(strings, seed: int = 0):
 
     S = np.asarray(strings)
     if S.dtype.kind != "U":
+        was_object = S.dtype == object
         S = S.astype(str)
+        if was_object:
+            # numpy U storage strips TRAILING U+0000, so such strings can't
+            # round-trip the vectorized layout (Java hashes them). Detect
+            # via python len (O(1) per string, no char scan) vs the stored
+            # width and hash per-row if any row lost characters. Non-str
+            # objects render via str() and can't contain NULs.
+            src = np.asarray(strings, dtype=object)
+            py_lens = np.fromiter(
+                (len(s) if isinstance(s, str) else -1 for s in src),
+                np.int64,
+                count=len(src),
+            )
+            if (py_lens > np.char.str_len(S)).any():
+                return np.asarray(
+                    [murmur3_hash_unencoded_chars(str(s), seed) for s in src],
+                    np.int64,
+                )
     n = S.shape[0]
     M = S.dtype.itemsize // 4
     if M == 0:

@@ -295,6 +295,24 @@ class TestNGram:
         out = NGram().set_n(4).set_input_col("input").set_output_col("o").transform(t)[0]
         assert list(out.column("o"))[0] == []
 
+    def test_dict_column_vocab_is_observed_only(self):
+        """The dictionary path decodes only grams that actually occur — the
+        u^n combinatorial vocabulary never materializes."""
+        from flink_ml_tpu.table import DictTokenMatrix
+
+        vocab = np.array([f"t{i}" for i in range(100)])
+        ids = np.array([[0, 1, 2], [1, 2, 3]], dtype=np.int32)
+        t = Table({"input": DictTokenMatrix(vocab, ids)})
+        out = NGram().set_input_col("input").set_output_col("o").transform(t)[0]
+        col = out.column("o")
+        assert isinstance(col, DictTokenMatrix)
+        # 3 distinct observed bigrams, not 100^2
+        assert set(col.vocab) == {"t0 t1", "t1 t2", "t2 t3"}
+        got = [
+            [col.vocab[i] for i in row if i >= 0] for row in np.asarray(col.ids)
+        ]
+        assert got == [["t0 t1", "t1 t2"], ["t1 t2", "t2 t3"]]
+
 
 class TestStopWordsRemover:
     def test_transform(self):
@@ -419,7 +437,7 @@ class TestSQLTransformerVectorized:
             np.asarray(out.column("scaled")), [[2.0, 4.0], [6.0, 8.0]]
         )
 
-    def test_where_falls_back_to_sqlite(self):
+    def test_where_scalar_filter(self):
         from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
 
         out = SQLTransformer().set_statement(
@@ -427,11 +445,124 @@ class TestSQLTransformerVectorized:
         ).transform(self._table())[0]
         assert out.num_rows == 1
 
+    def test_where_keeps_vector_columns(self):
+        """Filtered selects keep vector columns alive in the fast path —
+        the sqlite fallback cannot represent them at all."""
+        from flink_ml_tpu.models.feature.sqltransformer import (
+            SQLTransformer,
+            _try_vectorized_projection,
+        )
+
+        t = Table(
+            {
+                "vec": np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
+                "score": np.array([0.1, 0.9, 0.5]),
+            }
+        )
+        stmt = "SELECT vec * 2 AS scaled, score FROM __THIS__ WHERE score >= 0.5"
+        assert _try_vectorized_projection(stmt, t) is not None
+        out = SQLTransformer().set_statement(stmt).transform(t)[0]
+        np.testing.assert_array_equal(
+            np.asarray(out.column("scaled")), [[6.0, 8.0], [10.0, 12.0]]
+        )
+        np.testing.assert_array_equal(np.asarray(out.column("score")), [0.9, 0.5])
+
+    def test_where_boolean_combinators_match_sqlite(self):
+        from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
+        import flink_ml_tpu.models.feature.sqltransformer as mod
+
+        t = self._table()
+        stmt = (
+            "SELECT v1, v2 FROM __THIS__ "
+            "WHERE (v1 > 0 AND v2 < 6) OR NOT v2 >= 5"
+        )
+        fast = SQLTransformer().set_statement(stmt).transform(t)[0]
+        orig = mod._try_vectorized_projection
+        mod._try_vectorized_projection = lambda *_: None
+        try:
+            slow = SQLTransformer().set_statement(stmt).transform(t)[0]
+        finally:
+            mod._try_vectorized_projection = orig
+        for c in ("v1", "v2"):
+            np.testing.assert_allclose(
+                np.asarray(fast.column(c), np.float64),
+                np.asarray(slow.column(c), np.float64),
+            )
+
+    def test_where_nan_matches_sqlite_null_semantics(self):
+        """sqlite stores NaN as NULL and NULL comparisons drop the row; the
+        fast path's three-valued logic must agree — including under NOT,
+        where naive boolean negation would resurrect NaN rows."""
+        from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
+        import flink_ml_tpu.models.feature.sqltransformer as mod
+
+        t = Table(
+            {
+                "x": np.array([1.0, np.nan, 5.0, np.nan, 7.0]),
+                "y": np.array([0.0, 1.0, np.nan, 2.0, 3.0]),
+            }
+        )
+        for stmt in (
+            "SELECT x FROM __THIS__ WHERE x != 5",
+            "SELECT x FROM __THIS__ WHERE NOT x > 2",
+            "SELECT x FROM __THIS__ WHERE x > 0 OR y > 0",
+            "SELECT x FROM __THIS__ WHERE NOT (x > 2 AND y < 1)",
+        ):
+            fast = SQLTransformer().set_statement(stmt).transform(t)[0]
+            orig = mod._try_vectorized_projection
+            mod._try_vectorized_projection = lambda *_: None
+            try:
+                slow = SQLTransformer().set_statement(stmt).transform(t)[0]
+            finally:
+                mod._try_vectorized_projection = orig
+            np.testing.assert_array_equal(
+                np.asarray(fast.column("x"), np.float64),
+                np.asarray(slow.column("x"), np.float64),
+                err_msg=stmt,
+            )
+
+    def test_where_over_vector_column_falls_back(self):
+        """A comparison over a (n, d) vector column is not a row mask; the
+        fast path must decline rather than mis-filter."""
+        from flink_ml_tpu.models.feature.sqltransformer import (
+            _try_vectorized_projection,
+        )
+
+        t = Table({"vec": np.array([[1.0, -2.0], [3.0, 4.0]])})
+        assert _try_vectorized_projection(
+            "SELECT vec FROM __THIS__ WHERE vec > 0", t
+        ) is None
+
 
 class TestFeatureHasherVectorized:
     """The vectorized (batch-murmur) path must match the per-row dict path
     exactly, including categorical `col=value` hashing and bucket-collision
     summing."""
+
+    def test_nul_bearing_strings_match_reference_hash(self):
+        """Strings with embedded or TRAILING U+0000 reach the hash intact:
+        Table keeps them object-dtype, the per-row path hashes them with
+        the scalar reference-spec murmur (numpy U storage would strip the
+        trailing NUL and diverge from Java's hashUnencodedChars)."""
+        from flink_ml_tpu.models.feature.featurehasher import (
+            FeatureHasher,
+            _hash_index,
+        )
+
+        weird = ["a\x00b", "ab\x00", "plain", "\x00", "x"]
+        t = Table({"cat": weird})
+        out = (
+            FeatureHasher()
+            .set_input_cols("cat")
+            .set_categorical_cols("cat")
+            .set_num_features(64)
+            .transform(t)[0]
+        )
+        vecs = out.column("output")
+        for i, s in enumerate(weird):
+            idx = _hash_index(f"cat={s}", 64)
+            row = np.asarray(vecs.row(i).to_array())
+            assert row[idx] == 1.0, (s, idx, row.nonzero())
 
     def test_matches_per_row_path(self):
         import flink_ml_tpu.models.feature.featurehasher as fh

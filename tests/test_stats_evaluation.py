@@ -209,6 +209,33 @@ class TestDeviceEvaluatorParity:
         for name, expect in oracle.items():
             assert abs(got[name] - expect) < 2e-4, (name, got[name], expect)
 
+    def test_large_n_float32_deviation_bound(self):
+        """At benchmark-like scale (500k rows, heavy ties) the float32
+        device path must stay within the documented 1e-3 absolute bound of
+        the float64 oracle — pins the cumsum/tie-merge error growth the
+        4k-row test cannot see."""
+        from flink_ml_tpu.models.evaluation.binaryclassification import (
+            _binary_metrics,
+            _binary_metrics_device,
+        )
+
+        rng = np.random.default_rng(11)
+        n = 500_000
+        scores = np.round(rng.random(n) * 1000) / 1000  # ~1000 tie groups
+        labels = (rng.random(n) < scores).astype(np.float64)
+        weights = rng.random(n) + 0.1
+        oracle = _binary_metrics(scores, labels, weights)
+        packed = np.asarray(
+            _binary_metrics_device(
+                jnp.asarray(scores, jnp.float32),
+                jnp.asarray(labels, jnp.float32),
+                jnp.asarray(weights, jnp.float32),
+            )
+        )
+        got = dict(zip(["areaUnderROC", "areaUnderPR", "areaUnderLorenz", "ks"], packed))
+        for name, expect in oracle.items():
+            assert abs(got[name] - expect) < 1e-3, (name, got[name], expect)
+
     def test_single_class_nan_auc(self):
         from flink_ml_tpu.models.evaluation.binaryclassification import (
             _binary_metrics_device,
