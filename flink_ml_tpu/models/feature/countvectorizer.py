@@ -112,15 +112,14 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
             # dictionary-encoded path: vocab remap is a small host lut, the
             # per-row counting runs on device (sort + run lengths), and the
             # sparse output STAYS on device
-            import jax
 
             from ...ops import tokens as tokens_ops
 
             import jax.numpy as jnp
 
-            lut = jax.device_put(
-                _tokens.lookup(col.vocab, index).astype(np.int32)
-            )
+            # host lut: lets the chunked driver use the gather-free
+            # preimage kernel (vocab -> dict-id map is injective)
+            lut = _tokens.lookup(col.vocab, index).astype(np.int32)
             if min_tf >= 1.0:
                 thr = jnp.full((col.n,), min_tf, jnp.float32)
             else:

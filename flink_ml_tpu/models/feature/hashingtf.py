@@ -43,15 +43,14 @@ class HashingTF(Transformer, HashingTFParams):
         if isinstance(col, DictTokenMatrix):
             # dictionary-encoded path: hash only the (small) vocab on host,
             # bucket-map + per-row counting on device; output stays there
-            import jax
             import jax.numpy as jnp
 
             from ...ops import tokens as tokens_ops
 
-            lut = jax.device_put(
-                np.asarray(
-                    [hash_term(str(t)) % n_features for t in col.vocab], np.int32
-                )
+            # host lut: the chunked driver picks compare-map (small dicts)
+            # or gather; buckets collide, so the preimage form won't apply
+            lut = np.asarray(
+                [hash_term(str(t)) % n_features for t in col.vocab], np.int32
             )
             thr = jnp.ones((col.n,), jnp.float32)
             indices, values = tokens_ops.map_term_runs_chunked(

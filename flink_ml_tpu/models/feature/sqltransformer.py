@@ -353,7 +353,7 @@ def _try_vectorized_projection(statement: str, table: Table):
     if where is not None:
         try:
             mask = _ExprParser(_tokenize(where), table).parse_where()
-        except (ValueError, KeyError, IndexError, TypeError):
+        except (ValueError, KeyError, IndexError, TypeError, ZeroDivisionError):
             return None
         mask = np.asarray(mask)
         if mask.dtype != np.bool_ or mask.shape != (table.num_rows,):

@@ -97,7 +97,6 @@ class StopWordsRemover(Transformer, StopWordsRemoverParams):
             if isinstance(col, DictTokenMatrix):
                 # dictionary path: one (small) keep-mask over the vocab on
                 # host, token filtering on device; stays dictionary-encoded
-                import jax
 
                 from ...ops import tokens as tokens_ops
 
@@ -105,9 +104,9 @@ class StopWordsRemover(Transformer, StopWordsRemoverParams):
                     keep_vocab = ~np.isin(col.vocab, stop_arr)
                 else:
                     keep_vocab = ~np.isin(np.char.lower(col.vocab.astype(str)), stop_arr)
-                new_ids = tokens_ops.filter_tokens_chunked(
-                    col.ids, jax.device_put(keep_vocab)
-                )
+                # host mask: lets the chunked driver pick the gather-free
+                # dropset kernel (stopword hits are a small id set)
+                new_ids = tokens_ops.filter_tokens_chunked(col.ids, keep_vocab)
                 updates[out_name] = DictTokenMatrix(col.vocab, new_ids)
                 continue
             A = _tokens.token_matrix(col)

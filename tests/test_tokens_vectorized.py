@@ -352,3 +352,83 @@ class TestMixedLayoutConcat:
         d = _dict_col(np.asarray([["a", "x"]]))
         merged = Table({"tok": obj}).concat(Table({"tok": d}))
         assert [r["tok"] for r in merged.collect()] == [["x", "y"], [], ["a", "x"]]
+
+
+class TestGatherFreeMapKernels:
+    """The gather-free mapping kernels (preimage counts, compare-map,
+    dropset filter) must agree exactly with the gather forms they replace
+    — the gather form stays the reference semantics for big dictionaries."""
+
+    def _ids(self, n=500, k=16, u=40, seed=0):
+        import jax
+
+        rng = np.random.RandomState(seed)
+        ids = rng.randint(0, u, size=(n, k)).astype(np.int32)
+        ids[rng.random(ids.shape) < 0.1] = -1  # absent tokens
+        return jax.device_put(ids)
+
+    def test_preimage_counts_match_gather(self):
+        import jax
+        from flink_ml_tpu.ops import tokens as T
+
+        u, V = 40, 30
+        rng = np.random.RandomState(1)
+        # injective partial map: 30 of 40 dict ids keep a vocab slot
+        lut = np.full(u, -1, np.int32)
+        lut[rng.permutation(u)[:V]] = np.arange(V, dtype=np.int32)
+        ids = self._ids(u=u)
+        thr = np.ones(ids.shape[0], np.float32)
+        pre = T.lut_preimage(lut, V)
+        assert pre is not None
+        gi, gv = T._map_and_counts_dense(ids, jax.device_put(lut), thr, V)
+        pi, pv = T._counts_dense_preimage(ids, jax.device_put(pre), thr, V)
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(pi))
+        np.testing.assert_array_equal(np.asarray(gv), np.asarray(pv))
+
+    def test_preimage_rejects_collisions_and_range(self):
+        from flink_ml_tpu.ops import tokens as T
+
+        assert T.lut_preimage(np.asarray([0, 1, 1], np.int32), 4) is None
+        assert T.lut_preimage(np.asarray([0, 5], np.int32), 4) is None
+        assert T.lut_preimage(np.asarray([-1, 2, 0], np.int32), 3) is not None
+
+    def test_compare_map_matches_gather_with_collisions(self):
+        import jax
+        from flink_ml_tpu.ops import tokens as T
+
+        u = 40
+        lut = (np.arange(u, dtype=np.int32) * 7) % 13  # many collisions
+        lut[5] = -1  # dropped dict entry
+        ids = self._ids(u=u)
+        got = np.asarray(T.compare_map(ids, jax.device_put(lut)))
+        exp = np.asarray(T.gather_map(ids, jax.device_put(lut)))
+        np.testing.assert_array_equal(got, exp)
+
+    def test_map_term_runs_host_lut_matches_device_lut(self):
+        import jax
+        from flink_ml_tpu.ops import tokens as T
+
+        u, V = 40, 13
+        lut = ((np.arange(u, dtype=np.int32) * 7) % V).astype(np.int32)
+        ids = self._ids(u=u)
+        thr = np.ones(ids.shape[0], np.float32)
+        hi, hv = T.map_term_runs_chunked(ids, lut, thr, num_terms=V)
+        di, dv = T.map_term_runs_chunked(ids, jax.device_put(lut), thr, num_terms=V)
+        np.testing.assert_array_equal(np.asarray(hi), np.asarray(di))
+        np.testing.assert_array_equal(np.asarray(hv), np.asarray(dv))
+
+    def test_dropset_filter_matches_mask_gather(self):
+        import jax
+        from flink_ml_tpu.ops import tokens as T
+
+        u = 40
+        keep = np.ones(u, bool)
+        keep[[3, 7, 21]] = False
+        ids = self._ids(u=u)
+        got = np.asarray(T.filter_tokens_chunked(ids, keep))
+        exp = np.asarray(T.filter_tokens(ids, jax.device_put(keep)))
+        np.testing.assert_array_equal(got, exp)
+        # nothing dropped: identity
+        all_keep = np.ones(u, bool)
+        same = T.filter_tokens_chunked(ids, all_keep)
+        np.testing.assert_array_equal(np.asarray(same), np.asarray(ids))
