@@ -217,18 +217,10 @@ class LabeledPointWithWeightGenerator(DataGenerator):
         (names,) = self.get_col_names()
         n, d = self.get_num_values(), self.get_vector_dim()
         arity = self.get_feature_arity()
-        # Small categorical tables stay host-born: arity > 0 features often
-        # feed host-based consumers (NaiveBayes theta maps), and device
-        # birth would force the table back through the ~12MB/s tunnel at
-        # fit time. LARGE tables are device-born regardless — generating
-        # 1e9 ints in host numpy costs minutes on this single-core host,
-        # far worse than any readback the consumer might pay.
-        host_categorical = arity > 0 and n * d <= 20_000_000
-        if (
-            not host_categorical
-            and n >= DEVICE_GEN_THRESHOLD
-            and _device_gen_enabled()
-        ):
+        # Categorical tables are device-born like everything else: the
+        # categorical consumers (NaiveBayes fit/transform) aggregate on
+        # device now, so nothing pulls the table back through the tunnel.
+        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
             seed = self.get_seed() % (2**32)
             if arity == 0:
                 X = _device_uniform(seed, (n, d))

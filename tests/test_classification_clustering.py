@@ -46,6 +46,74 @@ class TestNaiveBayes:
         with pytest.raises(ValueError):
             model.transform(Table({"features": [Vectors.dense(9, 0)]}))
 
+    def test_device_fit_transform_matches_host(self):
+        """Device-resident input drives the MXU aggregation path; the
+        resulting model and predictions must match the host (float64)
+        reference path exactly — near-tie rows are host-refined."""
+        import jax
+
+        rng = np.random.RandomState(7)
+        X = rng.randint(0, 4, size=(3000, 6)).astype(np.float32)
+        y = rng.randint(0, 3, size=3000).astype(np.float32)
+        host = NaiveBayes().fit(Table({"features": X, "label": y}))
+        dev = NaiveBayes().fit(
+            Table({"features": jax.device_put(X), "label": jax.device_put(y)})
+        )
+        np.testing.assert_allclose(dev.pi, host.pi, rtol=1e-12)
+        np.testing.assert_array_equal(dev.labels, host.labels)
+        for i in range(len(host.labels)):
+            for j in range(X.shape[1]):
+                assert dev.theta[i][j].keys() == host.theta[i][j].keys()
+                for k in host.theta[i][j]:
+                    assert abs(dev.theta[i][j][k] - host.theta[i][j][k]) < 1e-12
+        ph = np.asarray(host.transform(Table({"features": X}))[0].column("prediction"))
+        pd = np.asarray(
+            dev.transform(Table({"features": jax.device_put(X)}))[0].column("prediction")
+        )
+        np.testing.assert_array_equal(ph, pd)
+
+    def test_device_zero_rows_and_inexact_labels_fall_back(self):
+        """Edge inputs the device kernels can't serve exactly must route
+        through the host path, not crash or round: zero-row tables and
+        labels/categories that are not f32-representable."""
+        import jax
+
+        rng = np.random.RandomState(1)
+        X = rng.randint(0, 3, size=(100, 4)).astype(np.float32)
+        y = np.where(rng.randint(0, 2, 100) > 0, 0.1, 0.2)  # not f32-exact
+        model = NaiveBayes().fit(Table({"features": jax.device_put(X), "label": y}))
+        out = model.transform(Table({"features": jax.device_put(X)}))[0]
+        pred = np.asarray(out.column("prediction"))
+        assert set(np.unique(pred)) <= {0.1, 0.2}  # exact f64 labels survive
+        empty = model.transform(
+            Table({"features": jax.device_put(np.zeros((0, 4), np.float32))})
+        )[0]
+        assert np.asarray(empty.column("prediction")).shape == (0,)
+
+    def test_device_unseen_value_raises(self):
+        import jax
+
+        rng = np.random.RandomState(3)
+        X = rng.randint(0, 4, size=(500, 3)).astype(np.float32)
+        y = rng.randint(0, 2, size=500).astype(np.float32)
+        model = NaiveBayes().fit(
+            Table({"features": jax.device_put(X), "label": jax.device_put(y)})
+        )
+        bad = X.copy()
+        bad[7, 1] = 99.0
+        with pytest.raises(ValueError, match="was not seen during training"):
+            model.transform(Table({"features": jax.device_put(bad)}))
+
+    def test_device_nan_label_raises(self):
+        import jax
+
+        X = np.zeros((8, 2), np.float32)
+        y = np.asarray([0, 1, 0, 1, np.nan, 0, 1, 0], np.float32)
+        with pytest.raises(ValueError, match="null/NaN"):
+            NaiveBayes().fit(
+                Table({"features": jax.device_put(X), "label": jax.device_put(y)})
+            )
+
     def test_save_load(self, tmp_path):
         model = NaiveBayes().fit(self._train())
         model.save(str(tmp_path / "nb"))
