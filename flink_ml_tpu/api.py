@@ -19,6 +19,12 @@ from .table import Table
 class Stage(WithParams, abc.ABC):
     """Base class for all pipeline nodes; persistable with params (Stage.java:43)."""
 
+    # Data-placement hint for loaders/generators: True when the stage's hot
+    # path is inherently host-resident (e.g. categorical string rendering),
+    # so inputs should be born host-side rather than in device HBM — the
+    # analogue of scheduling a source next to its consumer.
+    prefers_host_input: bool = False
+
     def save(self, path: str) -> None:
         from .utils import read_write
 

@@ -34,9 +34,24 @@ from ..table import DictTokenMatrix, Table
 DEVICE_GEN_THRESHOLD = 1_024
 
 
+_prefer_host = False
+
+
+def set_prefer_host(value: bool) -> None:
+    """Generate the next tables host-side. The runner sets this for stages
+    whose compute is inherently host-resident (categorical string
+    rendering): device-born data would cross the slow tunnel wholesale.
+    Placing birth next to compute is the data-loading layer's job — the
+    reference's generator sources likewise run inside the cluster."""
+    global _prefer_host
+    _prefer_host = value
+
+
 def _device_gen_enabled() -> bool:
     import os
 
+    if _prefer_host:
+        return False
     return os.environ.get("FLINK_ML_TPU_DEVICE_DATAGEN", "1") != "0"
 
 
@@ -166,12 +181,22 @@ class DoubleGenerator(DataGenerator):
         return self.set(self.ARITY, value)
 
     def get_data(self) -> List[Table]:
-        # scalar columns stay host-born: numpy generates ~1e8 doubles/s and
-        # the scalar-consuming stages (bucketizer, binarizer, imputer, SQL)
-        # are host-columnar — device birth would just force D2H round trips
+        # Device-born like the other generators: the scalar consumers
+        # (imputer, binarizer, bucketizer) aggregate on device now, and for
+        # the remaining host-columnar stages ONE bulk D2H pull (~GB/s) is
+        # still cheaper than single-core numpy generation of 1e8+ doubles.
         (names,) = self.get_col_names()
-        rng = self._rng()
         n, arity = self.get_num_values(), self.get_arity()
+        if n >= DEVICE_GEN_THRESHOLD and _device_gen_enabled():
+            seed = self.get_seed() % (2**32)
+            cols = {}
+            for i, name in enumerate(names):
+                if arity > 0:
+                    cols[name] = _device_randint_float(seed + i, (n,), arity)
+                else:
+                    cols[name] = _device_uniform(seed + i, (n,))
+            return [Table(cols)]
+        rng = self._rng()
         if arity > 0:
             return [
                 Table({name: rng.randint(0, arity, size=n).astype(np.float64) for name in names})

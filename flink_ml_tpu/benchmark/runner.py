@@ -84,7 +84,15 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
 
     with timed_phase("datagen"):
         stage = read_write.instantiate_with_params(entry["stage"])
-        input_tables = instantiate_generator(entry["inputData"]).get_data()
+        from . import datagenerator as dg
+
+        # stages that declare host-resident compute (Stage.prefers_host_input)
+        # get host-born inputs — see set_prefer_host
+        dg.set_prefer_host(bool(getattr(stage, "prefers_host_input", False)))
+        try:
+            input_tables = instantiate_generator(entry["inputData"]).get_data()
+        finally:
+            dg.set_prefer_host(False)
         _adapt_input_columns(stage, input_tables)
         model_tables: Optional[List[Table]] = None
         if "modelData" in entry:

@@ -16,6 +16,16 @@ from ...api import Transformer
 from ...common.param import HasInputCols, HasOutputCols
 from ...param import DoubleArrayParam, ParamValidators
 from ...table import SparseBatch, Table
+from ...utils.lazyjit import lazy_jit
+
+
+def _binarize_impl(arr, thr):
+    import jax.numpy as jnp
+
+    return jnp.where(arr > thr, 1.0, 0.0).astype(jnp.float32)
+
+
+_binarize_kernel = lazy_jit(_binarize_impl)
 
 
 class BinarizerParams(HasInputCols, HasOutputCols):
@@ -50,6 +60,13 @@ class Binarizer(Transformer, BinarizerParams):
                 values = np.where(col.values > thr, 1.0, 0.0)
                 updates[out_name] = SparseBatch(col.size, col.indices.copy(), values)
             else:
-                arr = np.asarray(col, dtype=np.float64)
-                updates[out_name] = np.where(arr > thr, 1.0, 0.0)
+                from .._linear import is_device_column
+
+                if is_device_column(col):  # elementwise: stays on device
+                    import jax.numpy as jnp
+
+                    updates[out_name] = _binarize_kernel(col, jnp.asarray(thr, col.dtype))
+                else:
+                    arr = np.asarray(col, dtype=np.float64)
+                    updates[out_name] = np.where(arr > thr, 1.0, 0.0)
         return [table.with_columns(updates)]

@@ -17,6 +17,24 @@ from ...api import Transformer
 from ...common.param import HasHandleInvalid, HasInputCols, HasOutputCols
 from ...param import DoubleArrayArrayParam, ParamValidators
 from ...table import Table
+from ...utils.lazyjit import lazy_jit
+
+
+def _bucketize_impl(arr, splits):
+    """Device bucket assignment: value in [splits[i], splits[i+1]) -> i,
+    last bucket right-closed (Bucketizer.java findBucket). The few split
+    points broadcast down lanes, so the 'searchsorted' is one compare-sum
+    sweep — no gather. Returns (idx, bad) with idx float for the output."""
+    import jax.numpy as jnp
+
+    num_buckets = splits.shape[0] - 1
+    idx = jnp.sum(arr[:, None] >= splits[None, :], axis=1) - 1
+    idx = jnp.where(arr == splits[-1], num_buckets - 1, idx)
+    bad = (arr < splits[0]) | (arr > splits[-1]) | jnp.isnan(arr)
+    return idx.astype(jnp.float32), bad
+
+
+_bucketize_kernel = lazy_jit(_bucketize_impl)
 
 
 class BucketizerParams(HasInputCols, HasOutputCols, HasHandleInvalid):
@@ -49,12 +67,29 @@ class Bucketizer(Transformer, BucketizerParams):
                 "Bucketizer: number of splits arrays must match number of input columns"
             )
         handle = self.get_handle_invalid()
+        from .._linear import is_device_column
+
         updates = {}
         invalid_mask = np.zeros(table.num_rows, dtype=bool)
+        bad_devs = []
         for name, out_name, splits in zip(in_cols, out_cols, splits_array):
-            arr = np.asarray(table.column(name), dtype=np.float64)
+            col = table.column(name)
             splits = np.asarray(splits, dtype=np.float64)
             num_buckets = len(splits) - 1
+            if is_device_column(col):
+                import jax
+                import jax.numpy as jnp
+
+                idx, bad = _bucketize_kernel(
+                    col, jax.device_put(splits.astype(np.float32))
+                )
+                if handle == HasHandleInvalid.KEEP_INVALID:
+                    idx = jnp.where(bad, float(num_buckets), idx)
+                else:
+                    bad_devs.append(bad)
+                updates[out_name] = idx
+                continue
+            arr = np.asarray(col, dtype=np.float64)
             # value in [splits[i], splits[i+1]) -> bucket i; last bucket is
             # closed on the right (Bucketizer.java findBucket semantics).
             idx = np.searchsorted(splits, arr, side="right") - 1
@@ -65,6 +100,14 @@ class Bucketizer(Transformer, BucketizerParams):
             else:
                 invalid_mask |= bad
             updates[out_name] = idx.astype(np.float64)
+        if bad_devs:
+            combined = bad_devs[0]
+            for b in bad_devs[1:]:
+                combined = combined | b
+            # scalar probe first: the full mask crosses the tunnel only
+            # when a row is actually invalid
+            if bool(combined.any()):
+                invalid_mask |= np.asarray(combined)
         out = table.with_columns(updates)
         if invalid_mask.any():
             if handle == HasHandleInvalid.ERROR_INVALID:

@@ -87,6 +87,9 @@ class FeatureHasherParams(HasInputCols, HasCategoricalCols, HasOutputCol, HasNum
 
 
 class FeatureHasher(Transformer, FeatureHasherParams):
+    # categorical hashing renders `col=value` strings — host work by nature
+    prefers_host_input = True
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         input_cols = self.get_input_cols()
@@ -121,29 +124,39 @@ class FeatureHasher(Transformer, FeatureHasherParams):
             # vectorized path: bucket indices come from batch murmur over
             # `col=value` strings (categorical) or the column-name hash
             # (numeric, one constant bucket per column, value summed); the
-            # per-row dict loop below is minutes at the benchmark's 10M rows
-            idx_cols, val_cols = [], []
-            for c in numeric_cols:
-                idx_cols.append(
-                    np.full(n, _hash_index(c, n_features), np.int64)
-                )
-                val_cols.append(host_cols[c].astype(np.float64))
-            for c in input_cols:
-                if c not in categorical:
-                    continue
-                idx_cols.append(_hash_categorical_column(host_cols[c], f"{c}=", n_features))
-                val_cols.append(np.ones(n, np.float64))
-            idxs = np.stack(idx_cols, axis=1)
-            vals = np.stack(val_cols, axis=1)
-            combined = _native.combine_hashed(idxs, vals)
-            if combined is not None:
-                indices, values = combined
-            else:
-                indices, values = _combine_hashed(idxs, vals)
+            # per-row dict loop below is minutes at the benchmark's 10M
+            # rows. Work proceeds in row chunks so the transient working
+            # set stays bounded — the per-column stacks and rendered
+            # strings are several times the chunk, and an all-at-once 10M
+            # pass thrashes hosts whose fast memory is limited.
+            ncol = len(input_cols)
+            chunk = 1_000_000
+            out_idx = np.empty((n, ncol), np.int32)
+            out_val = np.empty((n, ncol), np.float64)
+            numeric_bucket = {c: _hash_index(c, n_features) for c in numeric_cols}
+            for s in range(0, n, chunk):
+                e = min(n, s + chunk)
+                idx_cols, val_cols = [], []
+                for c in numeric_cols:
+                    idx_cols.append(np.full(e - s, numeric_bucket[c], np.int64))
+                    val_cols.append(host_cols[c][s:e].astype(np.float64))
+                for c in input_cols:
+                    if c not in categorical:
+                        continue
+                    idx_cols.append(
+                        _hash_categorical_column(host_cols[c][s:e], f"{c}=", n_features)
+                    )
+                    val_cols.append(np.ones(e - s, np.float64))
+                idxs = np.stack(idx_cols, axis=1)
+                vals = np.stack(val_cols, axis=1)
+                combined = _native.combine_hashed(idxs, vals)
+                if combined is None:
+                    combined = _combine_hashed(idxs, vals)
+                out_idx[s:e], out_val[s:e] = combined
             return [
                 table.with_column(
                     self.get_output_col(),
-                    SparseBatch(n_features, indices, values),
+                    SparseBatch(n_features, out_idx, out_val),
                 )
             ]
         features = [dict() for _ in range(n)]

@@ -24,12 +24,86 @@ from ...common.param import (
 )
 from ...param import ParamValidators, StringParam
 from ...table import Table
+from ...utils.lazyjit import keyed_jit
 from ...utils import read_write
 from ...utils.param_utils import update_existing_params
 
 MEAN = "mean"
 MEDIAN = "median"
 MOST_FREQUENT = "most_frequent"
+
+
+def _surrogate_impl(arr, missing, strategy: str):
+    """One column's surrogate on device (invalid entries masked), packed as
+    (numerator, denominator): mean -> (sum, count); median / most_frequent
+    -> (value, 1). Order statistics and counts are exact; the mean's f32
+    tree-reduction error is ~log(n)*eps relative, within the f32 data's
+    own precision. Sorting pushes masked entries to +inf, so the valid
+    prefix is dense (Imputer.java per-strategy aggregators)."""
+    import jax.numpy as jnp
+
+    mask = jnp.isnan(arr) if np.isnan(missing) else (arr == missing) | jnp.isnan(arr)
+    valid = ~mask
+    count = valid.sum()
+    if strategy == MEAN:
+        return jnp.where(valid, arr, 0).sum(), count.astype(arr.dtype)
+    S = jnp.sort(jnp.where(valid, arr, jnp.inf))
+    if strategy == MEDIAN:
+        lo = jnp.take(S, jnp.maximum((count - 1) // 2, 0))
+        hi = jnp.take(S, jnp.maximum(count // 2, 0))
+        return (lo + hi) * 0.5, jnp.asarray(1.0, arr.dtype)
+    # most_frequent: run lengths over the sorted valid prefix; first argmax
+    # = smallest among the most frequent (np.unique ordering)
+    n = S.shape[0]
+    idx = jnp.arange(n)
+    first = jnp.concatenate([jnp.ones((1,), bool), S[1:] != S[:-1]])
+    first &= idx < count  # runs only inside the valid prefix
+    first_pos = jnp.where(first, idx, n)
+    from jax import lax
+
+    suffix_min = lax.cummin(first_pos[::-1])[::-1]
+    next_first = jnp.concatenate([suffix_min[1:], jnp.full((1,), n)])
+    runlen = jnp.where(first, jnp.minimum(next_first, count) - idx, 0)
+    best = jnp.argmax(runlen)
+    return jnp.take(S, best), jnp.asarray(1.0, arr.dtype)
+
+
+def _missing_key(missing) -> tuple:
+    """Cache key for a missing-value config: NaN canonicalizes to a flag —
+    float('nan') != float('nan'), so a raw NaN key would MISS the compile
+    cache on every call and recompile per column."""
+    m = float(missing)
+    return (True, 0.0) if np.isnan(m) else (False, m)
+
+
+# keyed by (strategy, missing-key): both shape the traced program
+_surrogate_kernel_keyed = keyed_jit(
+    lambda strategy, is_nan, value: lambda arr: _surrogate_impl(
+        arr, float("nan") if is_nan else value, strategy
+    )
+)
+
+
+def _surrogate_kernel(strategy: str, missing: float):
+    return _surrogate_kernel_keyed(strategy, *_missing_key(missing))
+
+
+def _fill_impl(arr, surrogate, missing: float):
+    import jax.numpy as jnp
+
+    mask = jnp.isnan(arr) if np.isnan(missing) else arr == missing
+    return jnp.where(mask, surrogate, arr)
+
+
+_fill_kernel_keyed = keyed_jit(
+    lambda is_nan, value: lambda arr, surrogate: _fill_impl(
+        arr, surrogate, float("nan") if is_nan else value
+    )
+)
+
+
+def _fill_kernel(missing: float):
+    return _fill_kernel_keyed(*_missing_key(missing))
 
 
 class ImputerModelParams(HasInputCols, HasOutputCols, HasMissingValue):
@@ -77,14 +151,23 @@ class ImputerModel(Model, ImputerModelParams):
         ]
 
     def transform(self, *inputs: Table) -> List[Table]:
+        from .._linear import is_device_column
+
         (table,) = inputs
         missing = self.get_missing_value()
         updates = {}
         for name, out_name in zip(self.get_input_cols(), self.get_output_cols()):
-            arr = np.asarray(table.column(name), dtype=np.float64)
+            col = table.column(name)
             surrogate = self.surrogates[name]
             # only the configured missing value is replaced at transform time
             # (ImputerModel.java:159); fit-side NaNs are always excluded
+            if is_device_column(col):
+                # device columns stay on device: the fill is elementwise
+                updates[out_name] = _fill_kernel(float(missing))(
+                    col, np.float32(surrogate)
+                )
+                continue
+            arr = np.asarray(col, dtype=np.float64)
             mask = np.isnan(arr) if np.isnan(missing) else arr == missing
             updates[out_name] = np.where(mask, surrogate, arr)
         return [table.with_columns(updates)]
@@ -118,7 +201,33 @@ class Imputer(Estimator, ImputerParams):
         missing = self.get_missing_value()
         strategy = self.get_strategy()
         surrogates: Dict[str, float] = {}
-        for name in self.get_input_cols():
+        from .._linear import is_device_column
+
+        names = list(self.get_input_cols())
+        device_cols = [n for n in names if is_device_column(table.column(n))]
+        if device_cols:
+            # device columns aggregate on device; all surrogate scalars
+            # come back in ONE packed readback
+            from ...utils.packing import packed_device_get
+
+            kern = _surrogate_kernel(strategy, float(missing))
+            parts = []
+            for n_ in device_cols:
+                num, den = kern(table.column(n_))
+                parts.extend([num, den])
+            host_parts = packed_device_get(*parts)
+            dev_res: Dict[str, float] = {}
+            for i, n_ in enumerate(device_cols):
+                num, den = float(host_parts[2 * i]), float(host_parts[2 * i + 1])
+                if den == 0 or not np.isfinite(num):
+                    raise ValueError(
+                        f"Column {n_} has no valid values to impute from"
+                    )
+                dev_res[n_] = num / den if strategy == MEAN else num
+        for name in names:  # input order — it defines the model-data layout
+            if device_cols and name in dev_res:
+                surrogates[name] = dev_res[name]
+                continue
             arr = np.asarray(table.column(name), dtype=np.float64)
             mask = np.isnan(arr) if np.isnan(missing) else (arr == missing) | np.isnan(arr)
             valid = arr[~mask]

@@ -15,6 +15,18 @@ import numpy as np
 from ...api import Transformer
 from ...common.param import HasInputCols, HasOutputCol
 from ...table import Table, as_dense_matrix
+from ...utils.lazyjit import lazy_jit
+
+
+def _interact_impl(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        # (n, a) x (n, b) -> (n, a*b), earlier columns vary slowest
+        out = (out[:, :, None] * m[:, None, :]).reshape(out.shape[0], -1)
+    return out
+
+
+_interact_kernel = lazy_jit(_interact_impl)
 
 
 class InteractionParams(HasInputCols, HasOutputCol):
@@ -27,7 +39,17 @@ class Interaction(Transformer, InteractionParams):
         in_cols = self.get_input_cols()
         if not in_cols:
             raise ValueError("Parameter inputCols must be set")
-        mats = [as_dense_matrix(table.column(name)) for name in in_cols]
+        cols = [
+            as_dense_matrix(table.column(name), allow_device=True)
+            for name in in_cols
+        ]
+        import jax
+
+        if all(isinstance(m, jax.Array) for m in cols):
+            # all-device inputs: the outer products stay on device
+            out = _interact_kernel(*cols)
+            return [table.with_column(self.get_output_col(), out)]
+        mats = [np.asarray(m) for m in cols]
         out = mats[0]
         for m in mats[1:]:
             # (n, a) x (n, b) -> (n, a*b), earlier columns vary slowest.

@@ -17,7 +17,31 @@ from ...common.param import HasHandleInvalid, HasInputCols, HasOutputCols
 from ...param import BooleanParam
 from ...table import SparseBatch, Table
 from ...utils import read_write
+from ...utils.lazyjit import keyed_jit
 from ...utils.param_utils import update_existing_params
+
+
+def _onehot_impl(col, vec_size: int, drop: bool):
+    import jax.numpy as jnp
+
+    int_idx = col.astype(jnp.int32)
+    not_int = (int_idx.astype(col.dtype) != col) | (col < 0)
+    limit = vec_size if drop else vec_size - 1
+    out_of_range = int_idx > limit
+    bad = (not_int | out_of_range).any()
+    # index == vec_size (the dropped last category) -> empty vector
+    indices = jnp.where(int_idx < vec_size, int_idx, -1)[:, None]
+    values = jnp.where(indices >= 0, 1.0, 0.0).astype(jnp.float32)
+    return indices, values, bad
+
+
+_onehot_kernel_keyed = keyed_jit(
+    lambda vec_size, drop: lambda col: _onehot_impl(col, vec_size, drop)
+)
+
+
+def _onehot_kernel(col, vec_size: int, drop: bool):
+    return _onehot_kernel_keyed(vec_size, drop)(col)
 
 
 class OneHotEncoderModelParams(HasInputCols, HasOutputCols, HasHandleInvalid):
@@ -67,11 +91,25 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
             raise ValueError("OneHotEncoder only supports handleInvalid = 'error'")
         drop = 1 if self.get_drop_last() else 0
         updates = {}
+        from .._linear import is_device_column
+
         for i, (name, out_name) in enumerate(
             zip(self.get_input_cols(), self.get_output_cols())
         ):
             vec_size = int(self.category_sizes[i]) - drop
-            idx = np.asarray(table.column(name), dtype=np.float64)
+            col = table.column(name)
+            if is_device_column(col):
+                # device column: encode on device; one scalar probe
+                # validates (indexed integer, in range) without pulling
+                indices, values, bad = _onehot_kernel(col, vec_size, bool(drop))
+                if bool(bad):
+                    raise ValueError(
+                        f"The input contains an invalid (non-integer, negative "
+                        f"or out-of-range) index in column {name}."
+                    )
+                updates[out_name] = SparseBatch(vec_size, indices, values)
+                continue
+            idx = np.asarray(col, dtype=np.float64)
             int_idx = idx.astype(np.int64)
             if np.any(int_idx != idx) or np.any(int_idx < 0):
                 raise ValueError(f"Value cannot be parsed as indexed integer in column {name}")

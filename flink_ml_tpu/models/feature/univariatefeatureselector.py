@@ -162,7 +162,12 @@ class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
         if feature_type is None or label_type is None:
             raise ValueError("featureType and labelType must be set")
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        y_col = table.column(self.get_label_col())
+        from .._linear import is_device_column
+
+        # keep a device label column on device — the stats kernels consume
+        # it there; pulling 10M labels through the tunnel costs seconds
+        y = y_col if is_device_column(y_col) else np.asarray(y_col, dtype=np.float64)
         if feature_type == CATEGORICAL and label_type == CATEGORICAL:
             p_values, _, _ = stats.chi_square_test(X, y)
         elif feature_type == CONTINUOUS and label_type == CATEGORICAL:

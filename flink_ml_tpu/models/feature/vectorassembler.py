@@ -17,6 +17,17 @@ from ...api import Transformer
 from ...common.param import HasHandleInvalid, HasInputCols, HasOutputCol
 from ...param import IntArrayParam
 from ...table import Table, as_dense_matrix
+from ...utils.lazyjit import lazy_jit
+
+
+def _assemble_impl(*mats):
+    import jax.numpy as jnp
+
+    out = jnp.concatenate(mats, axis=1)
+    return out, jnp.isnan(out).any()
+
+
+_assemble_kernel = lazy_jit(_assemble_impl)
 
 
 class VectorAssemblerParams(HasInputCols, HasOutputCol, HasHandleInvalid):
@@ -41,15 +52,35 @@ class VectorAssembler(Transformer, VectorAssemblerParams):
             raise ValueError("Parameter inputCols must be set")
         sizes = self.get_input_sizes()
         handle = self.get_handle_invalid()
+        import jax
+
         mats = []
         for i, name in enumerate(in_cols):
-            m = as_dense_matrix(table.column(name))
+            m = as_dense_matrix(table.column(name), allow_device=True)
             if sizes is not None and m.shape[1] != sizes[i]:
                 raise ValueError(
                     f"Input column {name} has size {m.shape[1]}, "
                     f"declared inputSizes[{i}] = {sizes[i]}"
                 )
             mats.append(m)
+        if all(isinstance(m, jax.Array) for m in mats):
+            # all-device inputs: concat + NaN scan on device; the invalid
+            # flag is the only readback unless rows must be skipped
+            out, any_bad = _assemble_kernel(*mats)
+            result = table.with_column(self.get_output_col(), out)
+            if bool(any_bad):
+                if handle == HasHandleInvalid.ERROR_INVALID:
+                    raise ValueError(
+                        "Encountered NaN while assembling a row with handleInvalid = 'error'. "
+                        "Consider removing NaNs from dataset or using handleInvalid = 'keep' or 'skip'."
+                    )
+                if handle == HasHandleInvalid.SKIP_INVALID:
+                    import jax.numpy as jnp
+
+                    bad = np.asarray(jnp.isnan(out).any(axis=1))
+                    result = result.take(np.nonzero(~bad)[0])
+            return [result]
+        mats = [np.asarray(m) for m in mats]
         out = np.hstack(mats)
         bad = np.isnan(out).any(axis=1)
         result = table.with_column(self.get_output_col(), out)
