@@ -81,7 +81,7 @@ class Bucketizer(Transformer, BucketizerParams):
                 import jax.numpy as jnp
 
                 idx, bad = _bucketize_kernel(
-                    col, jax.device_put(splits.astype(np.float32))
+                    col, jnp.asarray(splits, col.dtype)
                 )
                 if handle == HasHandleInvalid.KEEP_INVALID:
                     idx = jnp.where(bad, float(num_buckets), idx)

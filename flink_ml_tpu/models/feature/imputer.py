@@ -162,9 +162,11 @@ class ImputerModel(Model, ImputerModelParams):
             # only the configured missing value is replaced at transform time
             # (ImputerModel.java:159); fit-side NaNs are always excluded
             if is_device_column(col):
-                # device columns stay on device: the fill is elementwise
+                # device columns stay on device: the fill is elementwise;
+                # the surrogate keeps the column's own dtype (a blanket f32
+                # cast would round f64 columns under x64)
                 updates[out_name] = _fill_kernel(float(missing))(
-                    col, np.float32(surrogate)
+                    col, np.asarray(surrogate, col.dtype)
                 )
                 continue
             arr = np.asarray(col, dtype=np.float64)

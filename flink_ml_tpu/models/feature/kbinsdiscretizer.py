@@ -151,15 +151,17 @@ def _col_min_max(a):
 
 @jax.jit
 def _bin_all(X, edges_mat, nbins):
-    """vmapped per-column searchsorted binning (module-level jit: an
-    inline jit would recompile on every transform)."""
-
-    def one(col, edges, nb):
-        idx = jnp.searchsorted(edges, col, side="right") - 1
-        idx = jnp.clip(idx, 0, jnp.maximum(nb, 0))
-        return jnp.where(nb > 0, idx, 0).astype(col.dtype)
-
-    return jax.vmap(one, in_axes=(1, 0, 0), out_axes=1)(X, edges_mat, nbins)
+    """Per-column binning as one compare-sum sweep: bucket = #edges <= x
+    minus 1 (== searchsorted side='right' - 1, +inf padding never counts).
+    The few edges broadcast down lanes — no per-element binary-search
+    gathers, which crawl on TPU. Module-level jit: an inline jit would
+    recompile on every transform."""
+    idx = jnp.sum(X[:, :, None] >= edges_mat[None, :, :], axis=2) - 1
+    # NaN compares false everywhere -> -1; searchsorted (the host path)
+    # sorts NaN above all edges -> top bin. Match the host semantics.
+    idx = jnp.where(jnp.isnan(X), jnp.int32(2**30), idx)
+    idx = jnp.clip(idx, 0, jnp.maximum(nbins, 0)[None, :])
+    return jnp.where(nbins[None, :] > 0, idx, 0).astype(X.dtype)
 
 
 class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):

@@ -40,13 +40,17 @@ class NGram(Transformer, NGramParams):
                 return [table.with_column(self.get_output_col(), out)]
             if u**n < 2**31:
                 # dictionary path: gram codes on device (int32-exact up to
-                # the 2^31 code space), gram vocab decoded lazily for the
-                # distinct codes actually observed — the combinatorial u^n
-                # space never materializes
+                # the 2^31 code space). Small code spaces materialize the
+                # full joined vocabulary eagerly (cheap host work, codes
+                # index it directly); big ones decode lazily for the codes
+                # actually observed — the combinatorial space never builds
                 from ...ops import tokens as tokens_ops
 
                 codes = tokens_ops.ngram_codes(col.ids, u, n)
-                vocab, codes = tokens_ops.ngram_vocab_observed(col.vocab, n, codes)
+                if u**n <= tokens_ops.NGRAM_EAGER_VOCAB_MAX:
+                    vocab = tokens_ops.ngram_vocab_full(col.vocab, n)
+                else:
+                    vocab, codes = tokens_ops.ngram_vocab_observed(col.vocab, n, codes)
                 return [
                     table.with_column(
                         self.get_output_col(), DictTokenMatrix(vocab, codes)

@@ -64,9 +64,24 @@ def _expand_columns(X: np.ndarray, degree: int) -> np.ndarray:
     return result
 
 
+from ...utils.lazyjit import keyed_jit
+
+# one fused program per degree: the eager recursion dispatches one device
+# op per monomial (~C(d+deg, deg) round trips); under jit the whole
+# expansion is a single fused elementwise kernel
+_expand_device = keyed_jit(
+    lambda degree: lambda X: _expand_columns(X, degree)
+)
+
+
 class PolynomialExpansion(Transformer, PolynomialExpansionParams):
     def transform(self, *inputs: Table) -> List[Table]:
+        import jax
+
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        out = _expand_columns(X, self.get_degree())
+        if isinstance(X, jax.Array):
+            out = _expand_device(self.get_degree())(X)
+        else:
+            out = _expand_columns(X, self.get_degree())
         return [table.with_column(self.get_output_col(), out)]

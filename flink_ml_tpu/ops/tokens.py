@@ -384,6 +384,36 @@ def ngram_codes(ids, num_terms, gram):
     return jnp.where(valid, code, -1)
 
 
+@jax.jit
+def _remap_codes(codes, uniq):
+    ranks = jnp.searchsorted(uniq, codes)
+    return jnp.where(codes >= 0, ranks.astype(jnp.int32), jnp.int32(-1))
+
+
+NGRAM_EAGER_VOCAB_MAX = 65_536
+"""Below this many u^gram combinations the full joined vocabulary builds
+eagerly on host (cheap, no device unique/remap round trip); above it only
+observed codes decode (`ngram_vocab_observed`). The bound also protects
+DOWNSTREAM consumers: stages that loop or sort the dictionary
+(HashingTF's per-term hash, CountVectorizer's vocab sort) see at most
+this many entries on the eager path."""
+
+
+def ngram_vocab_full(vocab: np.ndarray, gram: int) -> np.ndarray:
+    """All u^gram space-joined combinations in code order — for small
+    code spaces where materializing beats the observed-codes remap."""
+    if len(vocab) == 0:
+        return np.zeros(0, dtype="<U1")
+    grams = vocab.astype(object)
+    for _ in range(gram - 1):
+        grams = np.char.add(
+            np.char.add(grams[:, None].astype(str), " "), vocab[None, :].astype(str)
+        ).ravel()
+        grams = grams.astype(object)
+    width = (np.char.str_len(vocab.astype(str)).max() + 1) * gram
+    return grams.astype(f"<U{width}")
+
+
 def ngram_vocab_observed(vocab: np.ndarray, gram: int, codes):
     """N-gram vocabulary restricted to the codes actually observed, plus the
     code matrix reindexed to it. Returns (gram_vocab, remapped_ids).
@@ -397,10 +427,19 @@ def ngram_vocab_observed(vocab: np.ndarray, gram: int, codes):
     uniq_host = np.asarray(jnp.unique(codes.ravel()))
     uniq_host = uniq_host[uniq_host >= 0]
     # reindex codes to compact ranks on device (searchsorted over the
-    # sorted distinct codes); -1 sentinel passes through
+    # sorted distinct codes); -1 sentinel passes through. Chunked: the
+    # searchsorted loop materializes (rows, k) lane-padded temps at ~14x,
+    # which OOMs HBM on a whole 10M x 9 matrix in one program
     uniq_dev = jnp.asarray(uniq_host, jnp.int32)
-    ranks = jnp.searchsorted(uniq_dev, codes)
-    remapped = jnp.where(codes >= 0, ranks.astype(jnp.int32), jnp.int32(-1))
+    n_rows = codes.shape[0]
+    if n_rows <= CHUNK_ROWS:
+        remapped = _remap_codes(codes, uniq_dev)
+    else:
+        remapped = jnp.full(codes.shape, -1, jnp.int32)
+        for s in range(0, n_rows, CHUNK_ROWS):
+            remapped = _paste(
+                remapped, _remap_codes(codes[s : s + CHUNK_ROWS], uniq_dev), s
+            )
     if uniq_host.size == 0:
         return np.zeros(0, dtype="<U1"), remapped
     powers = u ** np.arange(gram - 1, -1, -1, dtype=np.int64)

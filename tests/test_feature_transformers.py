@@ -300,18 +300,19 @@ class TestNGram:
         u^n combinatorial vocabulary never materializes."""
         from flink_ml_tpu.table import DictTokenMatrix
 
-        vocab = np.array([f"t{i}" for i in range(100)])
-        ids = np.array([[0, 1, 2], [1, 2, 3]], dtype=np.int32)
+        # u^n = 300^3 = 2.7e7: above the eager-vocab bound, inside int32
+        vocab = np.array([f"t{i}" for i in range(300)])
+        ids = np.array([[0, 1, 2, 3], [1, 2, 3, 299]], dtype=np.int32)
         t = Table({"input": DictTokenMatrix(vocab, ids)})
-        out = NGram().set_input_col("input").set_output_col("o").transform(t)[0]
+        out = NGram().set_n(3).set_input_col("input").set_output_col("o").transform(t)[0]
         col = out.column("o")
         assert isinstance(col, DictTokenMatrix)
-        # 3 distinct observed bigrams, not 100^2
-        assert set(col.vocab) == {"t0 t1", "t1 t2", "t2 t3"}
+        # 4 distinct observed trigrams, not 300^3
+        assert set(col.vocab) == {"t0 t1 t2", "t1 t2 t3", "t2 t3 t299"}
         got = [
-            [col.vocab[i] for i in row if i >= 0] for row in np.asarray(col.ids)
+            [str(col.vocab[i]) for i in row if i >= 0] for row in np.asarray(col.ids)
         ]
-        assert got == [["t0 t1", "t1 t2"], ["t1 t2", "t2 t3"]]
+        assert got == [["t0 t1 t2", "t1 t2 t3"], ["t1 t2 t3", "t2 t3 t299"]]
 
 
 class TestStopWordsRemover:
@@ -630,3 +631,43 @@ def test_sqltransformer_div_by_zero_falls_back_to_sqlite():
         "SELECT v1, 1/0 AS x FROM __THIS__"
     ).transform(t)[0]
     assert out.num_rows == 2  # sqlite path: x is NULL, no crash
+
+
+class TestDeviceEdgeSemantics:
+    """Device kernels must match host semantics on the awkward inputs the
+    review process flagged: NaN binning and empty n-gram dictionaries."""
+
+    def test_kbins_nan_bins_like_host(self):
+        import jax
+
+        from flink_ml_tpu.models.feature.kbinsdiscretizer import (
+            KBinsDiscretizer,
+            KBinsDiscretizerModel,
+        )
+
+        X = np.asarray([[0.25], [np.nan], [0.75], [2.0]], np.float32)
+        train = Table({"input": np.asarray([[0.0], [0.5], [1.0]], np.float64)})
+        model = KBinsDiscretizer().set_input_col("input").set_output_col("o") \
+            .set_num_bins(2).set_strategy("uniform").fit(train)
+        host = np.asarray(model.transform(Table({"input": X.astype(np.float64)}))[0].column("o"))
+        dev = np.asarray(
+            model.transform(Table({"input": jax.device_put(X)}))[0].column("o"),
+            np.float64,
+        )
+        np.testing.assert_array_equal(dev, host)
+
+    def test_ngram_empty_vocab(self):
+        from flink_ml_tpu.models.feature.ngram import NGram
+        from flink_ml_tpu.table import DictTokenMatrix
+
+        t = Table({
+            "t": DictTokenMatrix(np.zeros(0, "<U1"), np.full((3, 4), -1, np.int32))
+        })
+        out = NGram().set_input_col("t").set_output_col("o").transform(t)[0]
+        col = out.column("o")
+        rows = (
+            [col.row(i) for i in range(len(col))]
+            if isinstance(col, DictTokenMatrix)
+            else [list(r) for r in col]
+        )
+        assert rows == [[], [], []]
