@@ -1,0 +1,44 @@
+"""SQLTransformer — SELECT/WHERE over a table with vector columns
+(reference: feature/sqltransformer/SQLTransformer.java; statements run
+against `__THIS__`). Projections and WHERE filters over vector columns
+evaluate columnwise on whole arrays — no row-at-a-time SQL engine."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.sqltransformer import SQLTransformer
+
+rng = np.random.default_rng(0)
+t = Table(
+    {
+        "features": rng.standard_normal((8, 3)),
+        "score": np.round(rng.random(8), 2),
+        "id": np.arange(8.0),
+    }
+)
+
+out = (
+    SQLTransformer()
+    .set_statement(
+        "SELECT id, features * 2 AS scaled, SQRT(score) AS conf "
+        "FROM __THIS__ WHERE score >= 0.4 AND NOT id = 3"
+    )
+    .transform(t)[0]
+)
+
+kept = np.asarray(out.column("id"))
+print("kept rows:", kept)
+mask = (np.asarray(t.column("score")) >= 0.4) & (np.arange(8.0) != 3)
+np.testing.assert_array_equal(kept, np.arange(8.0)[mask])
+np.testing.assert_allclose(
+    np.asarray(out.column("scaled")), np.asarray(t.column("features"))[mask] * 2
+)
+
+# aggregations fall back to a SQL engine transparently
+agg = (
+    SQLTransformer()
+    .set_statement("SELECT COUNT(*) AS n, AVG(score) AS mean_score FROM __THIS__")
+    .transform(t)[0]
+)
+print("count:", agg.collect()[0]["n"], "mean score:", round(agg.collect()[0]["mean_score"], 3))
+assert agg.collect()[0]["n"] == 8
