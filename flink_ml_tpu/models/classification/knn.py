@@ -39,6 +39,12 @@ class KnnParams(KnnModelParams, HasLabelCol):
     pass
 
 
+@jax.jit
+def _gather_labels(labels, idx):
+    """Module-level jit (an inline jit would recompile per transform)."""
+    return labels[idx]
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _top_k_indices(X_test, X_train, k):
     """Squared-euclidean pairwise distances -> top-k neighbor indices."""
@@ -92,7 +98,7 @@ class KnnModel(Model, KnnModelParams):
         # labels (float32 promotion corrupts indices above 2**24)
         if is_device_column(self.labels):
             neighbor_labels = np.asarray(
-                jax.jit(lambda lab, i: lab[i])(jnp.asarray(self.labels), idx_dev),
+                _gather_labels(jnp.asarray(self.labels), idx_dev),
                 dtype=np.float64,
             )
         else:

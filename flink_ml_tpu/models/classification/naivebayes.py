@@ -100,7 +100,13 @@ def _nb_predict_chunk_impl(Xc, cats, logp, pi, labels):
     pred = jnp.einsum("cl,l->c", onehot, labels, precision="highest")
     if L >= 2:  # top-2 score gap: rows inside f32 error get host-refined
         top2 = jax.lax.top_k(probs, 2)[0]
-        gap = top2[:, 0] - top2[:, 1]
+        # normalize the gap by the f32 accumulation error scale
+        # (~d * eps * |score|) so the host-rescore trigger holds for any
+        # feature count / score magnitude, not just the measured d=10 case
+        d = Xc.shape[1]
+        eps = jnp.float32(1.2e-7)
+        scale = d * eps * (jnp.abs(top2).sum(axis=1) + 1.0)
+        gap = (top2[:, 0] - top2[:, 1]) / scale
     else:
         gap = jnp.full(probs.shape[0], jnp.inf, probs.dtype)
     return pred, jnp.all(seen), seen, gap
@@ -265,11 +271,14 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
             pred = preds[0] if len(preds) == 1 else jnp.concatenate(preds)
             # exactness: rows whose top-2 score gap is inside the f32 error
             # bound rescore on host in f64, so device predictions match the
-            # reference's double-precision argmax bit-for-bit. The measured
-            # |f32 - f64| score error is <4e-6 at d=10 (bound ~d*eps*|logp|);
-            # 1e-4 keeps a 15x margin over the 2x-error flip radius while
-            # touching a vanishing fraction of rows on real data
-            ties = np.nonzero(gap_h < 1e-4)[0]
+            # reference's double-precision argmax bit-for-bit. The kernel
+            # returns the gap NORMALIZED by the worst-case error scale
+            # d*eps*|score| (the measured error is ~20x below that bound at
+            # d=10, so a factor-2 threshold keeps >20x margin over the flip
+            # radius at ANY width while touching a vanishing fraction of
+            # rows; at d=10, |score|~30 it reproduces the previously
+            # validated 1e-4 absolute cut)
+            ties = np.nonzero(gap_h < 2.0)[0]
             if ties.size:
                 Xt = np.asarray(X[jnp.asarray(ties)], np.float64)
                 pred = pred.at[jnp.asarray(ties)].set(

@@ -115,8 +115,8 @@ def _cluster_block_native(dist, linkage, num_clusters, threshold, compute_full_t
     from ...native import load as _load_native
 
     lib = _load_native()
-    if lib is None:
-        return None
+    if lib is None or not hasattr(lib, "agg_cluster"):
+        return None  # source may have failed to compile; numpy loop below
     n = dist.shape[0]
     dist = np.ascontiguousarray(dist)  # consumed in place; caller is done with it
     merges_out = np.empty((max(n - 1, 1), 4), dtype=np.float64)

@@ -28,6 +28,8 @@ class DataCache:
 
     def __init__(self, memory_budget_bytes: int = 64 << 20, spill_dir: Optional[str] = None):
         self._lib = _load_native()
+        if self._lib is not None and not hasattr(self._lib, "dc_create"):
+            self._lib = None  # datacache source may have failed to compile
         self._meta: List[Tuple] = []  # per-segment (dtype, shape)
         if self._lib is not None:
             spill_dir = spill_dir or tempfile.gettempdir()
@@ -101,6 +103,8 @@ def parse_csv_doubles(text: str, expected: Optional[int] = None) -> np.ndarray:
     """Fast float64 parsing of delimited numeric text via the native strtod
     loop; falls back to numpy.fromstring-style parsing without the lib."""
     lib = _load_native()
+    if lib is not None and not hasattr(lib, "dc_parse_csv_doubles"):
+        lib = None
     raw = text.encode()
     max_out = expected if expected is not None else max(1, len(raw) // 2 + 1)
     if lib is not None:

@@ -31,8 +31,8 @@ def hash_categorical_doubles(
 ) -> Optional[np.ndarray]:
     """Bucketed murmur3 of ``prefix + Double.toString(v)`` per row."""
     lib = _load_native()
-    if lib is None:
-        return None
+    if lib is None or not hasattr(lib, "fh_combine"):
+        return None  # hash-kernel source may have failed to compile
     pre = _prefix_units(prefix)
     if pre is None:
         return None
@@ -54,8 +54,8 @@ def hash_categorical_strings(
 ) -> Optional[np.ndarray]:
     """Bucketed murmur3 of ``prefix + s`` per row of a numpy '<U' column."""
     lib = _load_native()
-    if lib is None:
-        return None
+    if lib is None or not hasattr(lib, "fh_combine"):
+        return None  # hash-kernel source may have failed to compile
     pre = _prefix_units(prefix)
     if pre is None:
         return None
@@ -86,8 +86,8 @@ def combine_hashed(
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Per-row sort + duplicate-sum of (bucket, value) pairs → padded CSR."""
     lib = _load_native()
-    if lib is None:
-        return None
+    if lib is None or not hasattr(lib, "fh_combine"):
+        return None  # hash-kernel source may have failed to compile
     n, k = idxs.shape
     if k > _MAX_COLS:
         return None

@@ -23,6 +23,15 @@ import numpy as np
 from jax import lax
 
 
+def _count_dtype():
+    """tf/df accumulator dtype: int64 when x64 is enabled (exact past 2^31
+    corpus tokens), int32 otherwise (an int64 request would silently
+    truncate to int32 with a warning anyway)."""
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 @partial(jax.jit, static_argnames=("num_terms",))
 def term_counts(ids, num_terms):
     """Corpus term frequency + document frequency per vocab id, packed as
@@ -45,9 +54,10 @@ def term_counts(ids, num_terms):
     df = jnp.bincount(
         jnp.where(first, S, num_terms).ravel(), length=num_terms + 1
     )[:num_terms]
-    # int32 on purpose: with x64 off an int64 cast silently truncates
-    # anyway; counts are bounded by the corpus token count (< 2^31)
-    return jnp.stack([tf, df]).astype(jnp.int32)
+    # int32 under the default x64-off config (an int64 cast would silently
+    # truncate anyway, and counts are bounded by the corpus token count);
+    # exact int64 when x64 is enabled — corpora past 2^31 tokens stay exact
+    return jnp.stack([tf, df]).astype(_count_dtype())
 
 
 @partial(jax.jit, static_argnames=("binary",))
@@ -98,7 +108,7 @@ def _term_counts_dense(ids, num_terms):
     eq = ids[:, :, None] == jnp.arange(num_terms, dtype=ids.dtype)[None, None, :]
     tf = jnp.sum(eq, axis=(0, 1))
     df = jnp.sum(jnp.any(eq, axis=1), axis=0)
-    return jnp.stack([tf, df]).astype(jnp.int32)  # see term_counts
+    return jnp.stack([tf, df]).astype(_count_dtype())  # see term_counts
 
 
 def term_counts_chunked(ids, num_terms, chunk_rows: int = CHUNK_ROWS):

@@ -68,13 +68,26 @@ def save_iteration_checkpoint(path: str, carry, epoch: int, criteria: float) -> 
 
 
 def load_iteration_checkpoint(path: str, carry_like):
-    """Restore (carry, epoch, criteria) from `path`, or None if absent. The
-    checkpoint stores leaves positionally against `carry_like`'s treedef."""
+    """Restore (carry, epoch, criteria) from `path`, or None if absent OR
+    structurally incompatible. The checkpoint stores leaves positionally
+    against `carry_like`'s treedef; a leaf-count or leaf-shape mismatch
+    means the checkpoint belongs to a DIFFERENT job (e.g. another
+    estimator sharing the checkpoint dir) — restoring it positionally
+    would silently train from foreign state, so it is ignored."""
     file = os.path.join(path, "ckpt.npz")
     if not os.path.exists(file):
         return None
     with np.load(file) as f:
         leaves, treedef = jax.tree_util.tree_flatten(carry_like)
+        if any(f"leaf_{i}" not in f for i in range(len(leaves))) or (
+            f"leaf_{len(leaves)}" in f
+        ):
+            return None
+        for i, leaf in enumerate(leaves):
+            if hasattr(leaf, "shape") and tuple(f[f"leaf_{i}"].shape) != tuple(
+                np.shape(leaf)
+            ):
+                return None
         # restore on host: np keeps float64 leaves exact (jnp would truncate
         # under x64-off with a warning); the next jitted step device-puts
         restored = [
@@ -243,5 +256,11 @@ def iterate_unbounded(
         if checkpoint_dir is not None and version % interval == 0:
             save_iteration_checkpoint(checkpoint_dir, state, version, 0.0)
         yield version, state
+    if checkpoint_dir is not None:
+        # the stream completed: clear the checkpoint so a NEW job reusing
+        # this dir does not resume from (and skip past) a finished run
+        file = os.path.join(checkpoint_dir, "ckpt.npz")
+        if os.path.exists(file):
+            os.remove(file)
     if listener is not None:
         listener.on_iteration_terminated(state)
