@@ -19,6 +19,15 @@ from .table import Table
 class Stage(WithParams, abc.ABC):
     """Base class for all pipeline nodes; persistable with params (Stage.java:43)."""
 
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        # every concrete fit/transform automatically runs under a
+        # `stage.fit`/`stage.transform` span (obs/tracing.py) — per-class
+        # instrumentation code would rot; a subclass hook cannot
+        from .obs.tracing import instrument_stage_methods
+
+        instrument_stage_methods(cls)
+
     # Data-placement hint for loaders/generators: True when the stage's hot
     # path is inherently host-resident (e.g. categorical string rendering),
     # so inputs should be born host-side rather than in device HBM — the

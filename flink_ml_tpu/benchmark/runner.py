@@ -26,6 +26,7 @@ import numpy as np
 from typing import Dict, List, Optional
 
 from ..api import AlgoOperator, Estimator, Model
+from ..obs import tracing
 from ..table import Table
 from ..utils import metrics, read_write
 
@@ -67,20 +68,28 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
     """BenchmarkUtils.runBenchmark: generate input, fit/transform the stage,
     time end to end, report throughput — plus a per-phase wall-clock
     breakdown (datagen/fit/transform/collect) the reference's netRuntime
-    can't show (the tool that catches host-bound ingestion regressions)."""
+    can't show (the tool that catches host-bound ingestion regressions).
+
+    The result also embeds `metrics` — the registry delta this entry
+    produced (per-phase span timers, readback bytes/count, jit compile
+    count, collective/datacache counters), so an emitted BENCH json
+    carries its own evidence for perf claims."""
     from contextlib import contextmanager
 
+    tracing.install_jax_hooks()
+    metrics_before = metrics.snapshot()
     phases: Dict[str, float] = {}
 
     @contextmanager
     def timed_phase(phase: str):
         start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed = time.perf_counter() - start
-            phases[phase] = phases.get(phase, 0.0) + elapsed
-            metrics.record_time(f"benchmark.{name}.{phase}", elapsed)
+        with tracing.span("benchmark.phase", benchmark=name, phase=phase):
+            try:
+                yield
+            finally:
+                elapsed = time.perf_counter() - start
+                phases[phase] = phases.get(phase, 0.0) + elapsed
+                metrics.record_time(f"benchmark.{name}.{phase}", elapsed)
 
     with timed_phase("datagen"):
         stage = read_write.instantiate_with_params(entry["stage"])
@@ -134,6 +143,7 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "outputRecordNum": num_output,
         "outputThroughput": num_output * 1000.0 / elapsed_ms if elapsed_ms else 0.0,
         "phaseTimesMs": {k: v * 1000.0 for k, v in phases.items()},
+        "metrics": metrics.snapshot_delta(metrics_before, metrics.snapshot()),
     }
 
 
@@ -190,7 +200,10 @@ def _block_until_ready(tables: List[Table]) -> None:
                 if isinstance(arr, jax.Array):
                     probes.append(arr[(0,) * arr.ndim].astype(jnp.float32))
     if probes:
-        np.asarray(jnp.stack(probes))
+        t0 = time.perf_counter()
+        host = np.asarray(jnp.stack(probes))
+        # the barrier is itself a readback — account it like any other
+        tracing.account_readback(host.nbytes, time.perf_counter() - t0, len(probes))
 
 
 def execute_benchmarks(config: Dict) -> Dict[str, Dict]:

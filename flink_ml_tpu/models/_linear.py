@@ -75,6 +75,7 @@ def run_sgd(
     with an identical batch schedule, so both paths produce the same
     coefficients for the same data."""
     from .. import config
+    from ..parallel.iteration import checkpoint_job_key
     from ..table import StreamTable
 
     optimizer = SGD(
@@ -86,6 +87,13 @@ def run_sgd(
         elastic_net=params.get_elastic_net(),
         checkpoint_dir=config.iteration_checkpoint_dir,
         checkpoint_interval=config.iteration_checkpoint_interval,
+        # namespace the shared checkpoint dir per estimator identity so two
+        # different jobs can no longer silently cross-restore
+        checkpoint_key=(
+            checkpoint_job_key(params)
+            if config.iteration_checkpoint_dir is not None
+            else None
+        ),
     )
     if isinstance(table, StreamTable):
         chunks = _stream_chunks(

@@ -189,6 +189,12 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
         if not np.array_equal(labels_cast.astype(np.float64), self.labels):
             return None, None  # labels not f32-exact: decode would round
         for j, values in enumerate(per_col):
+            if not np.isfinite(values).all():
+                # +inf IS the padding sentinel: a trained +inf category
+                # would also match every padding slot of its column (logp 0
+                # each), corrupting the score sums — and NaN/-inf are not
+                # worth a separate device story. Host path scores exactly.
+                return None, None
             cast = values.astype(np.float32)
             if not np.array_equal(cast.astype(np.float64), values):
                 # categories not exactly f32-representable: the device
@@ -370,14 +376,28 @@ class NaiveBayes(Estimator, NaiveBayesParams):
                 return None  # labels not f32-exact: counts would merge
             y_dev = jnp.asarray(y32)
         Xs, m_per_col = _nb_sorted_cat_counts(X32)
-        # round trip 1: the three scalars the later programs are shaped by
-        nan_flag, m_max_arr, nunique = packed_device_get(
+        # round trip 1: the scalars the later programs are shaped by. The
+        # feature-NaN probe rides the same transfer: NaN features would
+        # silently inflate the category sets (NaN != NaN makes every NaN a
+        # distinct "category" through the sorted-compare counting), so they
+        # are rejected here exactly like NaN labels.
+        nan_flag, x_nan_flag, x_inf_flag, m_max_arr, nunique = packed_device_get(
             jnp.isnan(y_dev).any().astype(jnp.float32),
+            jnp.isnan(X32).any().astype(jnp.float32),
+            jnp.isposinf(X32).any().astype(jnp.float32),
             jnp.max(m_per_col).astype(jnp.float32),
             _nunique_device(y_dev).astype(jnp.float32),
         )
         if bool(nan_flag):
             raise ValueError("Label column contains null/NaN values")
+        if bool(x_nan_flag):
+            raise ValueError("Feature column contains null/NaN values")
+        if bool(x_inf_flag):
+            # +inf doubles as the category-padding sentinel in the count
+            # kernel — a real +inf feature would co-count with every padding
+            # slot. The host path trains inf categories exactly (and the
+            # predict-side _theta_tensors guard keeps serving them on host).
+            return None
         m_max = int(m_max_arr)
         if m_max > DEVICE_MAX_CATEGORIES:
             return None
@@ -453,6 +473,11 @@ class NaiveBayes(Estimator, NaiveBayesParams):
         y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
         if np.isnan(y).any():
             raise ValueError("Label column contains null/NaN values")
+        if np.isnan(X).any():
+            # matching the device probe: a NaN "category" can never be
+            # matched at predict time (NaN != NaN), so training would bake
+            # in unreachable probability mass — reject like NaN labels
+            raise ValueError("Feature column contains null/NaN values")
         labels = np.unique(y)
         num_labels = len(labels)
         label_counts = {float(l): int(np.sum(y == l)) for l in labels}

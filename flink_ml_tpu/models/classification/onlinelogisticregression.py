@@ -226,8 +226,12 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
                 alpha, beta, l1, l2,
             )
 
+        from ...parallel.iteration import checkpoint_job_key
+
         init = (coeff, np.zeros(d), np.zeros(d))
-        raw_updates = iterate_unbounded(rebatch(stream), step, init)
+        raw_updates = iterate_unbounded(
+            rebatch(stream), step, init, job_key=checkpoint_job_key(self)
+        )
         updates = ((version, state[0]) for version, state in raw_updates)
         model = OnlineLogisticRegressionModel()
         model.coefficient = coeff

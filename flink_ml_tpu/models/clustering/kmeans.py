@@ -224,20 +224,28 @@ class KMeans(Estimator, KMeansParams):
             X_dev = jax.device_put(X_pad, mat_sharding)
         w_dev = _unit_weights(n, n_pad, row_sharding)
 
-        centroids, counts = _lloyd_train(
-            X_dev,
-            w_dev,
-            init_centroids,
-            jnp.asarray(self.get_max_iter(), jnp.int32),
-            self.get_distance_measure(),
-        )
-
-        model = KMeansModel()
-        # one packed readback: (centroids, counts) pulled separately costs
-        # two ~100ms tunnel round trips (was half the 10k-row demo fit)
+        from ...obs import tracing
         from ...utils.packing import packed_device_get
 
-        host_centroids, host_counts = packed_device_get(centroids, counts)
+        # the Lloyd loop is one on-device while_loop (always maxIter
+        # epochs): no per-epoch host boundary exists, so a single
+        # `iteration.run` span carries the per-run summary
+        with tracing.span(
+            "iteration.run", mode="device", epochs=self.get_max_iter()
+        ):
+            centroids, counts = _lloyd_train(
+                X_dev,
+                w_dev,
+                init_centroids,
+                jnp.asarray(self.get_max_iter(), jnp.int32),
+                self.get_distance_measure(),
+            )
+
+            model = KMeansModel()
+            # one packed readback: (centroids, counts) pulled separately
+            # costs two ~100ms tunnel round trips (was half the 10k-row
+            # demo fit)
+            host_centroids, host_counts = packed_device_get(centroids, counts)
         model.centroids = np.asarray(host_centroids, dtype=np.float64)
         model.weights = np.asarray(host_counts, dtype=np.float64)
         update_existing_params(model, self)

@@ -214,7 +214,14 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
                 jnp.asarray(X), jnp.asarray(decay), measure_name,
             )
 
-        updates = iterate_unbounded(rebatch(stream), step, (centroids, weights))
+        from ...parallel.iteration import checkpoint_job_key
+
+        updates = iterate_unbounded(
+            rebatch(stream),
+            step,
+            (centroids, weights),
+            job_key=checkpoint_job_key(self),
+        )
         model = OnlineKMeansModel()
         model.centroids = centroids
         model.weights = weights

@@ -77,18 +77,25 @@ class Bucketizer(Transformer, BucketizerParams):
             splits = np.asarray(splits, dtype=np.float64)
             num_buckets = len(splits) - 1
             if is_device_column(col):
-                import jax
-                import jax.numpy as jnp
+                cast = splits.astype(np.dtype(col.dtype))
+                if np.array_equal(cast.astype(np.float64), splits):
+                    import jax
+                    import jax.numpy as jnp
 
-                idx, bad = _bucketize_kernel(
-                    col, jnp.asarray(splits, col.dtype)
-                )
-                if handle == HasHandleInvalid.KEEP_INVALID:
-                    idx = jnp.where(bad, float(num_buckets), idx)
-                else:
-                    bad_devs.append(bad)
-                updates[out_name] = idx
-                continue
+                    idx, bad = _bucketize_kernel(
+                        col, jnp.asarray(splits, col.dtype)
+                    )
+                    if handle == HasHandleInvalid.KEEP_INVALID:
+                        idx = jnp.where(bad, float(num_buckets), idx)
+                    else:
+                        bad_devs.append(bad)
+                    updates[out_name] = idx
+                    continue
+                # splits do not survive the column dtype (e.g. a float64
+                # boundary with no exact float32 representation): the device
+                # compare would move boundary values into the wrong bucket,
+                # so this column falls back to the exact host path
+                col = np.asarray(col)
             arr = np.asarray(col, dtype=np.float64)
             # value in [splits[i], splits[i+1]) -> bucket i; last bucket is
             # closed on the right (Bucketizer.java findBucket semantics).

@@ -19,18 +19,27 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import tracing
 from ..table import SparseBatch, Table
+from ..utils import metrics
 from . import load as _load_native
 
 
 class DataCache:
-    """Append-only segment cache with a memory budget and disk spill."""
+    """Append-only segment cache with a memory budget and disk spill.
+
+    Always-on accounting (utils/metrics counters): `datacache.append` /
+    `datacache.appendBytes`, `datacache.evict` (an append that spilled to
+    disk — the budget evicted it from memory), and per-read
+    `datacache.hit` (memory-resident) / `datacache.miss` (served from the
+    spill file) with `datacache.readBytes`."""
 
     def __init__(self, memory_budget_bytes: int = 64 << 20, spill_dir: Optional[str] = None):
         self._lib = _load_native()
         if self._lib is not None and not hasattr(self._lib, "dc_create"):
             self._lib = None  # datacache source may have failed to compile
         self._meta: List[Tuple] = []  # per-segment (dtype, shape)
+        self._spilled: List[bool] = []  # per-segment: lives in the spill file
         if self._lib is not None:
             spill_dir = spill_dir or tempfile.gettempdir()
             self._spill_path = os.path.join(
@@ -48,16 +57,27 @@ class DataCache:
         array = np.ascontiguousarray(array)
         self._meta.append((array.dtype, array.shape))
         data = array.tobytes()
+        metrics.inc_counter("datacache.append")
+        metrics.inc_counter("datacache.appendBytes", len(data))
         if self._handle is not None:
+            spilled_before = self.spilled_segments
             seg = self._lib.dc_append(self._handle, data, ctypes.c_uint64(len(data)))
             if seg < 0:
                 raise IOError("native data cache append failed")
+            spilled = self.spilled_segments > spilled_before
+            self._spilled.append(spilled)
+            if spilled:  # over budget: this segment was evicted to disk
+                metrics.inc_counter("datacache.evict")
+                tracing.event("cache.evict", category="cache", bytes=len(data), seg=int(seg))
             return int(seg)
         self._segments.append(data)
+        self._spilled.append(False)
         return len(self._segments) - 1
 
     def read_array(self, seg: int) -> np.ndarray:
         dtype, shape = self._meta[seg]
+        hit = not (seg < len(self._spilled) and self._spilled[seg])
+        metrics.inc_counter("datacache.hit" if hit else "datacache.miss")
         if self._handle is not None:
             size = self._lib.dc_segment_size(self._handle, ctypes.c_long(seg))
             out = np.empty(size, dtype=np.uint8)
@@ -66,7 +86,9 @@ class DataCache:
             )
             if rc != 0:
                 raise IOError(f"native data cache read failed with code {rc}")
+            metrics.inc_counter("datacache.readBytes", int(size))
             return out.view(dtype).reshape(shape)
+        metrics.inc_counter("datacache.readBytes", len(self._segments[seg]))
         return np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape)
 
     @property

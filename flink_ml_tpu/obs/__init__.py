@@ -1,0 +1,33 @@
+"""Hierarchical observability layer — span tracing + runtime accounting.
+
+The reference delegates observability to the Flink web UI, slf4j and
+per-operator metric groups; this package is the TPU-native equivalent the
+flat registry in `utils/metrics.py` cannot provide: *where* a slow
+`Pipeline.fit` spends its time, split into compute / collective / readback
+/ compile, without re-running under the device profiler.
+
+Three layers:
+
+- `tracing` — a context-var-based `span(name, **attrs)` API producing
+  nested spans with monotonic timestamps, emitted as structured JSONL
+  (`FLINK_ML_TPU_TRACE_FILE`) or an in-memory ring buffer
+  (`FLINK_ML_TPU_TRACE_RING`), and aggregated into `metrics.snapshot()`.
+  The no-op path (no sink configured) is a shared singleton context
+  manager — cheap enough to stay always-on.
+- `exporters` — render `metrics.snapshot()` as JSON or Prometheus text.
+- `report` — reduce a JSONL trace to per-stage / per-epoch time-breakdown
+  tables with category accounting (see `scripts/obs_report.py`).
+
+See docs/observability.md for the full surface and a worked example.
+"""
+
+from .tracing import (  # noqa: F401
+    add_attr,
+    configure,
+    current_span,
+    drain_ring,
+    enabled,
+    event,
+    install_jax_hooks,
+    span,
+)

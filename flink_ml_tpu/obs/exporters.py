@@ -1,0 +1,50 @@
+"""Exporters — render the metrics registry as JSON or Prometheus text.
+
+Both operate on `metrics.snapshot()` (or any snapshot-shaped dict, e.g.
+the per-entry deltas the benchmark runner embeds in its result JSON), so
+a snapshot captured at one point can be exported later or off-process.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Optional
+
+from ..utils import metrics
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def snapshot_json(snap: Optional[Dict] = None, indent: int = 2) -> str:
+    """The registry as a JSON document (timers/gauges/counters)."""
+    return json.dumps(snap if snap is not None else metrics.snapshot(), indent=indent)
+
+
+def _prom_name(prefix: str, name: str) -> str:
+    return _NAME_RE.sub("_", f"{prefix}_{name}")
+
+
+def snapshot_prometheus(snap: Optional[Dict] = None, prefix: str = "flink_ml_tpu") -> str:
+    """The registry in the Prometheus text exposition format.
+
+    Counters map to `<prefix>_<name>_total`, gauges to `<prefix>_<name>`,
+    and each timer to a `_ms_total` counter plus a `_count` counter (the
+    summary pair scrapers can rate() over)."""
+    snap = snap if snap is not None else metrics.snapshot()
+    lines = []
+    for name, value in sorted(snap.get("counters", {}).items()):
+        metric = _prom_name(prefix, name) + "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {value}")
+    for name, value in sorted(snap.get("gauges", {}).items()):
+        metric = _prom_name(prefix, name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {value}")
+    for name, stats in sorted(snap.get("timers", {}).items()):
+        base = _prom_name(prefix, name)
+        lines.append(f"# TYPE {base}_ms_total counter")
+        lines.append(f"{base}_ms_total {stats['totalMs']}")
+        lines.append(f"# TYPE {base}_count counter")
+        lines.append(f"{base}_count {stats['count']}")
+    return "\n".join(lines) + "\n"

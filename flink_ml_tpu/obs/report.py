@@ -1,0 +1,295 @@
+"""Trace reduction — JSONL spans → per-stage / per-epoch breakdown tables.
+
+Consumes the JSONL a run writes under `FLINK_ML_TPU_TRACE_FILE` and
+answers the question the flat registry cannot: where did the wall time of
+each pipeline stage / training epoch go, split into
+
+- `collective` — host-side collective funnels (+ trace-time collective op
+  events, reported as count/bytes),
+- `readback`   — device→host transfers (packed readbacks, phase barriers),
+- `compile`    — XLA backend compiles (jax.monitoring),
+- `cache`      — native datacache traffic,
+- `compute`    — the residual: device execution + host compute dispatched
+  under the span (synchronous host-driven steps make this the dominant
+  real-work bucket).
+
+Category times are summed over each container's *outermost* categorized
+descendants, so nested categorized spans never double-count and the five
+buckets sum to the container's wall time exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional
+
+CATEGORIES = ("collective", "readback", "compile", "cache")
+_STAGE_NAMES = ("pipeline.stage", "stage.fit", "stage.transform")
+
+
+def load_trace(path: str) -> List[Dict]:
+    """Parse a JSONL trace file; tolerates trailing partial lines."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+class Trace:
+    """Indexed view of a span list: parent/child links + category sums."""
+
+    def __init__(self, records: Iterable[Dict]):
+        self.records = list(records)
+        self.by_id = {r["spanId"]: r for r in self.records}
+        self.children: Dict[int, List[Dict]] = {}
+        for r in self.records:
+            self.children.setdefault(r.get("parentId", 0), []).append(r)
+
+    def ancestors(self, record: Dict):
+        parent = self.by_id.get(record.get("parentId", 0))
+        while parent is not None:
+            yield parent
+            parent = self.by_id.get(parent.get("parentId", 0))
+
+    def descendants(self, record: Dict):
+        stack = list(self.children.get(record["spanId"], ()))
+        while stack:
+            r = stack.pop()
+            yield r
+            stack.extend(self.children.get(r["spanId"], ()))
+
+    @staticmethod
+    def category(record: Dict) -> Optional[str]:
+        return (record.get("attrs") or {}).get("category")
+
+    def _categorized_between(self, record: Dict, container: Dict) -> bool:
+        """True when a categorized span sits strictly between `record` and
+        `container` on the parent chain."""
+        parent = self.by_id.get(record.get("parentId", 0))
+        while parent is not None and parent["spanId"] != container["spanId"]:
+            if self.category(parent) in CATEGORIES:
+                return True
+            parent = self.by_id.get(parent.get("parentId", 0))
+        return False
+
+    def breakdown(self, record: Dict) -> Dict[str, float]:
+        """Wall-time split of one container span: categorized time from its
+        outermost categorized descendants, `compute` as the residual."""
+        wall = float(record.get("durUs", 0.0))
+        out = {c: 0.0 for c in CATEGORIES}
+        for d in self.descendants(record):
+            cat = self.category(d)
+            if cat not in out:
+                continue
+            # outermost-categorized only: a readback nested inside a cache
+            # span (or any categorized ancestor below `record`) is already
+            # paid by its enclosing categorized span
+            if self._categorized_between(d, record):
+                continue
+            out[cat] += float(d.get("durUs", 0.0))
+        out["compute"] = max(0.0, wall - sum(out.values()))
+        out["wall"] = wall
+        return out
+
+    def collective_stats(self, record: Dict) -> Dict[str, Dict[str, float]]:
+        """Trace-time collective op events under a container: count + bytes
+        per op (zero-duration — dispatched into the XLA program)."""
+        stats: Dict[str, Dict[str, float]] = {}
+        for d in self.descendants(record):
+            name = d.get("name", "")
+            if not name.startswith("collective."):
+                continue
+            attrs = d.get("attrs") or {}
+            agg = stats.setdefault(name[len("collective."):], {"count": 0, "bytes": 0})
+            agg["count"] += 1
+            agg["bytes"] += int(attrs.get("bytes", 0))
+        return stats
+
+
+def stage_records(trace: Trace) -> List[Dict]:
+    """The stage-level containers: `pipeline.stage` spans when a Pipeline
+    ran, else outermost `stage.fit`/`stage.transform` spans."""
+    pipeline_stages = [r for r in trace.records if r.get("name") == "pipeline.stage"]
+    if pipeline_stages:
+        return sorted(pipeline_stages, key=lambda r: r.get("startUs", 0.0))
+    out = []
+    for r in trace.records:
+        if r.get("name") not in ("stage.fit", "stage.transform"):
+            continue
+        if any(a.get("name") in _STAGE_NAMES for a in trace.ancestors(r)):
+            continue
+        out.append(r)
+    return sorted(out, key=lambda r: r.get("startUs", 0.0))
+
+
+def epoch_records(trace: Trace) -> List[Dict]:
+    return sorted(
+        (r for r in trace.records if r.get("name") == "iteration.epoch"),
+        key=lambda r: r.get("startUs", 0.0),
+    )
+
+
+def run_summaries(trace: Trace) -> List[Dict]:
+    """`iteration.run` records — the per-run summary the on-device
+    while_loop path emits instead of per-epoch spans."""
+    return sorted(
+        (r for r in trace.records if r.get("name") == "iteration.run"),
+        key=lambda r: r.get("startUs", 0.0),
+    )
+
+
+def _stage_label(record: Dict) -> str:
+    attrs = record.get("attrs") or {}
+    stage = attrs.get("stage", "?")
+    if record.get("name") == "pipeline.stage":
+        op = attrs.get("op", "")
+        idx = attrs.get("index")
+        prefix = f"[{idx}] " if idx is not None else ""
+        return f"{prefix}{stage}.{op}" if op else f"{prefix}{stage}"
+    op = record["name"].rsplit(".", 1)[-1]
+    return f"{stage}.{op}"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def fmt(cells):
+        return "  ".join(c.ljust(w) if i == 0 else c.rjust(w)
+                         for i, (c, w) in enumerate(zip(cells, widths)))
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines.extend(fmt(r) for r in rows)
+    return "\n".join(lines)
+
+
+def _breakdown_row(label: str, b: Dict[str, float]) -> List[str]:
+    wall = b["wall"]
+    cells = [label, f"{wall / 1000.0:.1f}"]
+    for cat in ("compute",) + CATEGORIES:
+        pct = 100.0 * b.get(cat, 0.0) / wall if wall > 0 else 0.0
+        cells.append(f"{b.get(cat, 0.0) / 1000.0:.1f} ({pct:.0f}%)")
+    return cells
+
+
+def render_report(records: List[Dict], max_epochs: int = 20) -> str:
+    """The human-readable report: stage table, epoch table, run summaries,
+    collective traffic, and the dominant time category."""
+    trace = Trace(records)
+    sections = []
+    headers = ["", "wallMs", "compute", "collective", "readback", "compile", "cache"]
+
+    stages = stage_records(trace)
+    totals = {c: 0.0 for c in ("wall", "compute") + CATEGORIES}
+    if stages:
+        rows = []
+        for r in stages:
+            b = trace.breakdown(r)
+            rows.append(_breakdown_row(_stage_label(r), b))
+            for k in totals:
+                totals[k] += b.get(k, 0.0)
+        rows.append(_breakdown_row("TOTAL", totals))
+        sections.append("== Per-stage breakdown ==\n" + _table(headers, rows))
+    else:
+        sections.append("== Per-stage breakdown ==\n(no stage spans in trace)")
+
+    epochs = epoch_records(trace)
+    if epochs:
+        rows = []
+        shown = epochs if len(epochs) <= max_epochs else epochs[:max_epochs]
+        etotals = {c: 0.0 for c in ("wall", "compute") + CATEGORIES}
+        for r in epochs:
+            b = trace.breakdown(r)
+            for k in etotals:
+                etotals[k] += b.get(k, 0.0)
+        for r in shown:
+            b = trace.breakdown(r)
+            label = f"epoch {(r.get('attrs') or {}).get('epoch', '?')}"
+            rows.append(_breakdown_row(label, b))
+        if len(epochs) > len(shown):
+            rows.append([f"... {len(epochs) - len(shown)} more", "", "", "", "", "", ""])
+        rows.append(_breakdown_row(f"TOTAL ({len(epochs)} epochs)", etotals))
+        sections.append("== Per-epoch breakdown ==\n" + _table(headers, rows))
+
+    runs = run_summaries(trace)
+    if runs:
+        lines = []
+        for r in runs:
+            attrs = r.get("attrs") or {}
+            n = attrs.get("epochs")
+            wall_ms = float(r.get("durUs", 0.0)) / 1000.0
+            per = f", {wall_ms / n:.2f} ms/epoch" if n else ""
+            lines.append(
+                f"- mode={attrs.get('mode', '?')} epochs={n} "
+                f"wallMs={wall_ms:.1f}{per}"
+                + (f" finalCriteria={attrs['finalCriteria']:.4g}"
+                   if "finalCriteria" in attrs else "")
+            )
+        sections.append(
+            "== Iteration runs (on-device loops report one summary span) ==\n"
+            + "\n".join(lines)
+        )
+
+    # collective traffic across the whole trace
+    root = {"spanId": 0, "durUs": 0.0}
+    trace.children.setdefault(0, [])
+    coll = trace.collective_stats(root)
+    if coll:
+        rows = [
+            [op, str(int(s["count"])), f"{int(s['bytes'])}"]
+            for op, s in sorted(coll.items())
+        ]
+        sections.append(
+            "== Collective ops (recorded at trace time; bytes = payload per call) ==\n"
+            + _table(["op", "calls", "bytes"], rows)
+        )
+
+    if totals["wall"] > 0:
+        cats = OrderedDict((c, totals.get(c, 0.0)) for c in ("compute",) + CATEGORIES)
+        dominant = max(cats, key=cats.get)
+        pct = 100.0 * cats[dominant] / totals["wall"]
+        sections.append(
+            f"Dominant category: {dominant} "
+            f"({cats[dominant] / 1000.0:.1f} ms, {pct:.0f}% of stage wall time)"
+        )
+
+    return "\n\n".join(sections)
+
+
+def render_device_profile(path: str) -> str:
+    """Cross-reference a jax.profiler device trace (traceprof.analyze_trace)
+    against the host-side span accounting."""
+    import glob
+    import os
+
+    from ..utils.traceprof import analyze_trace
+
+    if os.path.isdir(path):
+        candidates = sorted(
+            glob.glob(
+                os.path.join(path, "plugins", "profile", "*", "*.trace.json.gz")
+            )
+        )
+        if not candidates:
+            return f"== Device profile ==\n(no *.trace.json.gz under {path})"
+        path = candidates[-1]
+    stats = analyze_trace(path)
+    lines = [
+        f"deviceBusyMs: {stats['deviceBusyMs']:.1f}",
+        f"moduleExecutions: {stats['numModuleExecutions']}",
+        f"hbmBytesAccessed: {stats['hbmBytesAccessed']}",
+    ]
+    cats = stats.get("byCategory", {})
+    if cats:
+        lines.append("top HLO categories: " + ", ".join(
+            f"{k} {v['durUs'] / 1000.0:.1f}ms" for k, v in list(cats.items())[:5]
+        ))
+    return "== Device profile (" + path + ") ==\n" + "\n".join(lines)

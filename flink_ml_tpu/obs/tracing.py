@@ -1,0 +1,314 @@
+"""Span tracing core — nested, structured, always-on-cheap.
+
+A span is one timed region of host control flow: a pipeline stage fit, a
+training epoch, a packed device→host readback, an XLA compile. Spans nest
+through a `contextvars.ContextVar`, so the parent chain survives threads
+spawned with a copied context and is correct under generators.
+
+Emission targets (either or both, process-wide):
+
+- JSONL file — set `FLINK_ML_TPU_TRACE_FILE` (or `configure(trace_file=)`).
+  One JSON object per line, schema:
+  `{"name", "spanId", "parentId", "startUs", "durUs", "attrs"}` with
+  `startUs` monotonic microseconds from the process trace origin.
+- ring buffer — set `FLINK_ML_TPU_TRACE_RING=<n>` (or
+  `configure(ring_size=n)`); `drain_ring()` returns and clears it.
+
+With no sink configured `span()` returns a shared no-op context manager:
+one global load + one call, no allocation — the always-on budget the
+instrumented hot layers rely on (bounded by a micro-benchmark test).
+
+Completed spans are also folded into the flat `utils.metrics` registry
+(`span.<name>` timers), so `metrics.snapshot()` keeps working as the one
+aggregate view.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from ..utils import metrics
+
+# Monotonic origin for startUs: perf_counter_ns at import. JSONL consumers
+# only need ordering + durations, not wall-clock identity.
+_ORIGIN_NS = time.perf_counter_ns()
+
+_ids = itertools.count(1)
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "flink_ml_tpu_obs_span", default=None
+)
+
+_lock = threading.Lock()
+_trace_path: Optional[str] = None
+_trace_file = None  # lazily-opened append handle for _trace_path
+_ring: Optional[deque] = None
+_enabled = False  # fast-path flag: True iff a sink is configured
+
+
+def enabled() -> bool:
+    """True when a trace sink (file or ring) is configured."""
+    return _enabled
+
+
+def configure(
+    trace_file: Optional[str] = None, ring_size: Optional[int] = None
+) -> None:
+    """(Re)configure the process-wide trace sinks. `None`/0 for both
+    disables tracing entirely (the no-op fast path)."""
+    global _trace_path, _trace_file, _ring, _enabled
+    with _lock:
+        if _trace_file is not None:
+            _trace_file.close()
+            _trace_file = None
+        _trace_path = trace_file or None
+        _ring = deque(maxlen=int(ring_size)) if ring_size else None
+        _enabled = _trace_path is not None or _ring is not None
+    if _enabled:
+        install_jax_hooks()
+
+
+def _init_from_env() -> None:
+    path = os.environ.get("FLINK_ML_TPU_TRACE_FILE")
+    ring = os.environ.get("FLINK_ML_TPU_TRACE_RING")
+    if path or ring:
+        configure(trace_file=path, ring_size=int(ring) if ring else None)
+
+
+def drain_ring():
+    """Return and clear the in-memory ring buffer's span records."""
+    with _lock:
+        if _ring is None:
+            return []
+        out = list(_ring)
+        _ring.clear()
+    return out
+
+
+def _emit(record: Dict[str, Any]) -> None:
+    global _trace_file
+    with _lock:
+        if _ring is not None:
+            _ring.append(record)
+        if _trace_path is not None:
+            if _trace_file is None:
+                _trace_file = open(_trace_path, "a", buffering=1)
+            _trace_file.write(json.dumps(record) + "\n")
+
+
+class _NoopSpan:
+    """Shared do-nothing span — the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "_start_ns", "_token")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self):
+        if not _jax_hooks_installed:
+            # configure() may have run before jax was imported; by the time
+            # real spans open, any jax work below them has imported it
+            install_jax_hooks()
+        parent = _current.get()
+        self.parent_id = parent.span_id if parent is not None else 0
+        self.span_id = next(_ids)
+        self._token = _current.set(self)
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end_ns = time.perf_counter_ns()
+        _current.reset(self._token)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        dur_ns = end_ns - self._start_ns
+        metrics.record_time("span." + self.name, dur_ns / 1e9)
+        _emit(
+            {
+                "name": self.name,
+                "spanId": self.span_id,
+                "parentId": self.parent_id,
+                "startUs": (self._start_ns - _ORIGIN_NS) / 1000.0,
+                "durUs": dur_ns / 1000.0,
+                "attrs": self.attrs,
+            }
+        )
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing a named region nested under the current span.
+
+    Inside the block, `set_attr`/`add_attr` attach further attributes
+    (e.g. results known only at the end). With no sink configured this
+    returns a shared no-op object — the call itself is the only cost."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Zero-duration mark under the current span (e.g. a collective op
+    recorded at trace time, a device-loop run summary)."""
+    if not _enabled:
+        return
+    parent = _current.get()
+    _emit(
+        {
+            "name": name,
+            "spanId": next(_ids),
+            "parentId": parent.span_id if parent is not None else 0,
+            "startUs": (time.perf_counter_ns() - _ORIGIN_NS) / 1000.0,
+            "durUs": 0.0,
+            "attrs": attrs,
+        }
+    )
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def add_attr(key: str, value) -> None:
+    """Attach an attribute to the innermost active span (no-op outside)."""
+    sp = _current.get()
+    if sp is not None:
+        sp.attrs[key] = value
+
+
+def emit_completed(name: str, start_ns: int, dur_s: float, **attrs) -> None:
+    """Record a span whose timing was measured externally (e.g. an XLA
+    compile reported by jax.monitoring after the fact)."""
+    if not _enabled:
+        return
+    parent = _current.get()
+    _emit(
+        {
+            "name": name,
+            "spanId": next(_ids),
+            "parentId": parent.span_id if parent is not None else 0,
+            "startUs": (start_ns - _ORIGIN_NS) / 1000.0,
+            "durUs": dur_s * 1e6,
+            "attrs": attrs,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# device/runtime accounting: readbacks, XLA compiles
+# ---------------------------------------------------------------------------
+
+def account_readback(nbytes: int, seconds: float, arrays: int = 1) -> None:
+    """Fold one device→host transfer into the registry (+ a trace span).
+    Called by the explicit readback funnels (`utils.packing`, the benchmark
+    runner's phase barriers) — the paths every fit/transform readback rides."""
+    metrics.inc_counter("readback.count")
+    metrics.inc_counter("readback.bytes", int(nbytes))
+    metrics.record_time("readback", seconds)
+    if _enabled:
+        emit_completed(
+            "readback",
+            time.perf_counter_ns() - int(seconds * 1e9),
+            seconds,
+            category="readback",
+            bytes=int(nbytes),
+            arrays=arrays,
+        )
+
+
+_jax_hooks_installed = False
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def install_jax_hooks() -> bool:
+    """Register a `jax.monitoring` listener translating backend-compile
+    events into `jit.compiles`/`jit.compile` metrics and `category=compile`
+    spans. Idempotent; deferred until jax is already imported so this
+    module never pays the jax import itself."""
+    global _jax_hooks_installed
+    if _jax_hooks_installed:
+        return True
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    import jax.monitoring
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        metrics.inc_counter("jit.compiles")
+        metrics.record_time("jit.compile", duration)
+        if _enabled:
+            emit_completed(
+                "jit.compile",
+                time.perf_counter_ns() - int(duration * 1e9),
+                duration,
+                category="compile",
+            )
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _jax_hooks_installed = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# automatic stage instrumentation (wired from api.Stage.__init_subclass__)
+# ---------------------------------------------------------------------------
+
+def _wrap_stage_method(fn, op: str):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if not _enabled:
+            return fn(self, *args, **kwargs)
+        with Span("stage." + op, {"stage": type(self).__name__}):
+            return fn(self, *args, **kwargs)
+
+    wrapper._obs_instrumented = True
+    return wrapper
+
+
+def instrument_stage_methods(cls) -> None:
+    """Wrap a Stage subclass's own `fit`/`transform` in `stage.fit` /
+    `stage.transform` spans. Inherited (already wrapped) definitions are
+    left alone, so each call produces exactly one span."""
+    for op in ("fit", "transform"):
+        fn = cls.__dict__.get(op)
+        if fn is None or not callable(fn):
+            continue
+        if getattr(fn, "_obs_instrumented", False) or getattr(
+            fn, "__isabstractmethod__", False
+        ):
+            continue
+        setattr(cls, op, _wrap_stage_method(fn, op))
+
+
+_init_from_env()

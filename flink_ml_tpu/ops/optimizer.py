@@ -322,6 +322,11 @@ class SGD:
     dtype: jnp.dtype = jnp.float32
     checkpoint_dir: Optional[str] = None
     checkpoint_interval: int = 1
+    checkpoint_key: Optional[str] = None
+    """Job-identity namespace for the checkpoint file (see
+    iteration.checkpoint_job_key) — estimator-level callers set it so jobs
+    sharing a checkpoint dir cannot cross-restore; None keeps the legacy
+    un-namespaced `ckpt.npz` for direct SGD users."""
     shard_features: bool = False
     """Also shard the feature dimension over the mesh `model` axis — the
     tensor-parallel layout for wide (e.g. sparse-Criteo-dim) models
@@ -513,7 +518,9 @@ class SGD:
         if self.checkpoint_dir is not None:
             from ..parallel.iteration import load_iteration_checkpoint
 
-            restored = load_iteration_checkpoint(self.checkpoint_dir, carry)
+            restored = load_iteration_checkpoint(
+                self.checkpoint_dir, carry, self.checkpoint_key
+            )
             if restored is not None:
                 carry, epoch, criteria = restored
         nb = len(segs)
@@ -536,18 +543,21 @@ class SGD:
                 jax.device_put(cache.read_array(sw), row_sharding),
             )
 
+        from ..obs import tracing
+
         executor = ThreadPoolExecutor(max_workers=1)
         fut = executor.submit(fetch, epoch % nb)
         try:
             while epoch < self.max_iter and criteria > self.tol:
-                k = epoch % nb
-                if k != last_k:  # nb == 1 reads/uploads the batch only once
-                    batch_dev = fut.result()
-                    last_k = k
-                    if nb > 1:
-                        fut = executor.submit(fetch, (epoch + 1) % nb)
-                carry, crit = _stream_epoch(*batch_dev, carry, loss_func, lr, reg, en)
-                criteria = float(crit)
+                with tracing.span("iteration.epoch", epoch=epoch, mode="stream"):
+                    k = epoch % nb
+                    if k != last_k:  # nb == 1 reads/uploads the batch only once
+                        batch_dev = fut.result()
+                        last_k = k
+                        if nb > 1:
+                            fut = executor.submit(fetch, (epoch + 1) % nb)
+                    carry, crit = _stream_epoch(*batch_dev, carry, loss_func, lr, reg, en)
+                    criteria = float(crit)
                 epoch += 1
                 if (
                     self.checkpoint_dir is not None
@@ -556,7 +566,8 @@ class SGD:
                     from ..parallel.iteration import save_iteration_checkpoint
 
                     save_iteration_checkpoint(
-                        self.checkpoint_dir, carry, epoch, criteria
+                        self.checkpoint_dir, carry, epoch, criteria,
+                        self.checkpoint_key,
                     )
             coeff, grad, wsum, _ = carry
             coeff = _update_model(coeff, grad, wsum, lr, reg, en)
@@ -650,16 +661,23 @@ class SGD:
             jnp.asarray(0.0, self.dtype),
             jnp.asarray(0, jnp.int32),
         )
+        from ..obs import tracing
+
         epoch, criteria = 0, float("inf")
-        restored = load_iteration_checkpoint(self.checkpoint_dir, carry)
+        restored = load_iteration_checkpoint(
+            self.checkpoint_dir, carry, self.checkpoint_key
+        )
         if restored is not None:
             carry, epoch, criteria = restored
         while epoch < self.max_iter and criteria > self.tol:
-            carry, crit = _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, en)
-            criteria = float(crit)
+            with tracing.span("iteration.epoch", epoch=epoch, mode="checkpointed"):
+                carry, crit = _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, en)
+                criteria = float(crit)
             epoch += 1
             if epoch % self.checkpoint_interval == 0:
-                save_iteration_checkpoint(self.checkpoint_dir, carry, epoch, criteria)
+                save_iteration_checkpoint(
+                    self.checkpoint_dir, carry, epoch, criteria, self.checkpoint_key
+                )
         coeff, grad, wsum, _ = carry
         coeff = _update_model(coeff, grad, wsum, lr, reg, en)
         return np.asarray(coeff), criteria, epoch

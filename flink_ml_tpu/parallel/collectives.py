@@ -19,10 +19,41 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import tracing
+from ..utils import metrics
 from .mesh import DATA_AXIS
+
+
+def _account(op: str, x, axis_name: str) -> None:
+    """Record one collective call: op, per-participant payload bytes and
+    chunk (pytree-leaf) count. These wrappers run INSIDE jitted/shard_map
+    code, so this fires at TRACE time — once per compiled program, not per
+    execution — which is exactly when the op's shape is known; the
+    counters answer "what collective traffic does this program dispatch",
+    the device profile answers how long it took."""
+    try:
+        leaves = jax.tree_util.tree_leaves(x)
+        nbytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in leaves
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+        )
+    except Exception:
+        leaves, nbytes = [x], 0
+    metrics.inc_counter(f"collective.{op}.calls")
+    metrics.inc_counter(f"collective.{op}.bytes", nbytes)
+    if tracing.enabled():
+        tracing.event(
+            f"collective.{op}",
+            category="collective",
+            bytes=nbytes,
+            chunks=len(leaves),
+            axis=axis_name,
+        )
 
 
 def all_reduce_sum(x, axis_name: str = DATA_AXIS):
@@ -32,18 +63,22 @@ def all_reduce_sum(x, axis_name: str = DATA_AXIS):
     scatter-reduce/all-gather chunking the reference hand-rolls is what the
     ICI hardware reduction does natively.
     """
+    _account("psum", x, axis_name)
     return lax.psum(x, axis_name)
 
 
 def all_reduce_mean(x, axis_name: str = DATA_AXIS):
+    _account("pmean", x, axis_name)
     return lax.pmean(x, axis_name)
 
 
 def all_reduce_max(x, axis_name: str = DATA_AXIS):
+    _account("pmax", x, axis_name)
     return lax.pmax(x, axis_name)
 
 
 def all_reduce_min(x, axis_name: str = DATA_AXIS):
+    _account("pmin", x, axis_name)
     return lax.pmin(x, axis_name)
 
 
@@ -51,17 +86,22 @@ def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = True)
     """Gather shards onto every participant — the analogue of broadcast-
     collecting a distributed result (e.g. countWindowAll funnel + rebroadcast,
     KMeans.java:168-173, without the parallelism-1 funnel bottleneck)."""
+    _account("all_gather", x, axis_name)
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str = DATA_AXIS, scatter_dimension: int = 0):
+    _account("psum_scatter", x, axis_name)
     return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=True)
 
 
 def ppermute_ring(x, axis_name: str = DATA_AXIS, shift: int = 1):
     """Ring shift along an axis — building block for ring pipelines
     (ring attention / pipelined all-reduce patterns)."""
-    n = lax.axis_size(axis_name)
+    _account("ppermute", x, axis_name)
+    # pre-graft jax lacks lax.axis_size; psum of the constant 1 folds to the
+    # static axis size at trace time on both versions
+    n = lax.axis_size(axis_name) if hasattr(lax, "axis_size") else lax.psum(1, axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -78,8 +118,16 @@ def shard_map_over(mesh: Mesh, in_specs, out_specs, fn=None, check_vma: bool = F
     """
 
     def wrap(f):
-        return jax.shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        if hasattr(jax, "shard_map"):
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+            )
+        # pre-graft jax (< 0.6): shard_map lives under experimental with
+        # check_rep instead of check_vma
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
         )
 
     return wrap(fn) if fn is not None else wrap
@@ -99,4 +147,15 @@ def host_all_reduce_sum(mesh: Mesh, xs):
     def _sum(stacked):
         return jnp.sum(stacked, axis=0)
 
-    return _sum(jnp.stack([jnp.asarray(x) for x in xs]))
+    # host-driven (not inside a trace): this span measures the real
+    # per-call stack+upload+reduce wall time
+    with tracing.span("collective.host_all_reduce_sum", category="collective") as sp:
+        stacked = jnp.stack([jnp.asarray(x) for x in xs])
+        sp.set_attr("bytes", int(stacked.size * stacked.dtype.itemsize))
+        sp.set_attr("chunks", len(xs))
+        metrics.inc_counter("collective.host_all_reduce_sum.calls")
+        metrics.inc_counter(
+            "collective.host_all_reduce_sum.bytes",
+            int(stacked.size * stacked.dtype.itemsize),
+        )
+        return _sum(stacked)

@@ -19,7 +19,9 @@ vs the reference's in-flight feedback-record logging, Checkpoints.java:92-143).
 
 from __future__ import annotations
 
+import json
 import os
+import re
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional, Tuple
 
@@ -27,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..obs import tracing
 
 BodyFn = Callable[[Any, jax.Array], Tuple[Any, jax.Array]]
 
@@ -54,27 +58,64 @@ class IterationResult:
 # checkpointing: epoch-boundary snapshots of the carry pytree
 # ---------------------------------------------------------------------------
 
-def save_iteration_checkpoint(path: str, carry, epoch: int, criteria: float) -> None:
+def checkpoint_job_key(stage, exclude=("maxIter", "tol")) -> str:
+    """Stable job-identity key for checkpoint namespacing: estimator class
+    name + a hash of its params. Two jobs with identical carry STRUCTURE
+    but different hyper-parameters (e.g. two OnlineKMeans runs with the
+    same k and d) then write different checkpoint files under a shared
+    `config.iteration_checkpoint_dir` instead of silently cross-restoring.
+
+    Termination-schedule params (`maxIter`, `tol`) are excluded by
+    default: resuming an interrupted run with a larger maxIter is the
+    canonical resume pattern and must map to the SAME job."""
+    import hashlib
+
+    params = {}
+    for p, v in stage.get_param_map().items():
+        if p.name in exclude:
+            continue
+        try:
+            params[p.name] = p.json_encode(v)
+        except Exception:
+            params[p.name] = repr(v)
+    blob = json.dumps(params, sort_keys=True, default=repr)
+    digest = hashlib.sha1(blob.encode()).hexdigest()[:10]
+    return f"{type(stage).__name__}-{digest}"
+
+
+def _checkpoint_file(path: str, job_key: Optional[str]) -> str:
+    if job_key is None:
+        return os.path.join(path, "ckpt.npz")
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", job_key)
+    return os.path.join(path, f"ckpt-{safe}.npz")
+
+
+def save_iteration_checkpoint(
+    path: str, carry, epoch: int, criteria: float, job_key: Optional[str] = None
+) -> None:
     leaves = jax.tree_util.tree_leaves(carry)
     os.makedirs(path, exist_ok=True)
-    tmp = os.path.join(path, "ckpt.tmp.npz")
+    target = _checkpoint_file(path, job_key)
+    tmp = target[: -len(".npz")] + ".tmp.npz"  # keep .npz so savez won't rename
     np.savez(
         tmp,
         epoch=np.int64(epoch),
         criteria=np.float64(criteria),
         **{f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)},
     )
-    os.replace(tmp, os.path.join(path, "ckpt.npz"))
+    os.replace(tmp, target)
 
 
-def load_iteration_checkpoint(path: str, carry_like):
+def load_iteration_checkpoint(path: str, carry_like, job_key: Optional[str] = None):
     """Restore (carry, epoch, criteria) from `path`, or None if absent OR
-    structurally incompatible. The checkpoint stores leaves positionally
-    against `carry_like`'s treedef; a leaf-count or leaf-shape mismatch
-    means the checkpoint belongs to a DIFFERENT job (e.g. another
-    estimator sharing the checkpoint dir) — restoring it positionally
+    structurally incompatible. With a `job_key` (see `checkpoint_job_key`)
+    the lookup is namespaced per job, so structurally-identical jobs
+    sharing a directory stay isolated. The structural guard remains for
+    un-keyed callers: the checkpoint stores leaves positionally against
+    `carry_like`'s treedef; a leaf-count or leaf-shape mismatch means the
+    checkpoint belongs to a DIFFERENT job — restoring it positionally
     would silently train from foreign state, so it is ignored."""
-    file = os.path.join(path, "ckpt.npz")
+    file = _checkpoint_file(path, job_key)
     if not os.path.exists(file):
         return None
     with np.load(file) as f:
@@ -144,13 +185,20 @@ def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[fl
         return new_carry, epoch + 1, jnp.asarray(criteria, jnp.float32)
 
     init_state = (init_carry, jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, jnp.float32))
-    with metrics.timed("iteration.device_loop"):
-        carry, epochs, criteria = jax.jit(
-            lambda s: lax.while_loop(cond, step, s)
-        )(init_state)
-        jax.block_until_ready(criteria)
-    metrics.set_gauge("iteration.epochs", int(epochs))
-    return IterationResult(carry, int(epochs), float(criteria))
+    # the whole loop is one XLA program, so per-epoch spans are impossible
+    # here by design — a single `iteration.run` span carries the per-run
+    # summary (epoch count, final criteria) instead
+    with tracing.span("iteration.run", mode="device") as sp:
+        with metrics.timed("iteration.device_loop"):
+            carry, epochs, criteria = jax.jit(
+                lambda s: lax.while_loop(cond, step, s)
+            )(init_state)
+            jax.block_until_ready(criteria)
+        num_epochs, final = int(epochs), float(criteria)
+        sp.set_attr("epochs", num_epochs)
+        sp.set_attr("finalCriteria", final)
+    metrics.set_gauge("iteration.epochs", num_epochs)
+    return IterationResult(carry, num_epochs, final)
 
 
 def _iterate_host_driven(
@@ -166,16 +214,21 @@ def _iterate_host_driven(
 
     from ..utils import metrics
 
-    while epoch < max_iter and (tol is None or criteria > tol):
-        with metrics.timed("iteration.epoch"):
-            carry, criteria_arr = jitted(carry, jnp.asarray(epoch, jnp.int32))
-            criteria = float(criteria_arr)
-        epoch += 1
-        metrics.set_gauge("iteration.epochs", epoch)
-        if listener is not None:
-            listener.on_epoch_watermark_incremented(epoch, carry)
-        if checkpoint_dir is not None and epoch % checkpoint_interval == 0:
-            save_iteration_checkpoint(checkpoint_dir, carry, epoch, criteria)
+    with tracing.span("iteration.run", mode="host") as run_sp:
+        while epoch < max_iter and (tol is None or criteria > tol):
+            with tracing.span("iteration.epoch", epoch=epoch) as ep_sp:
+                with metrics.timed("iteration.epoch"):
+                    carry, criteria_arr = jitted(carry, jnp.asarray(epoch, jnp.int32))
+                    criteria = float(criteria_arr)
+                ep_sp.set_attr("criteria", criteria)
+            epoch += 1
+            metrics.set_gauge("iteration.epochs", epoch)
+            if listener is not None:
+                listener.on_epoch_watermark_incremented(epoch, carry)
+            if checkpoint_dir is not None and epoch % checkpoint_interval == 0:
+                save_iteration_checkpoint(checkpoint_dir, carry, epoch, criteria)
+        run_sp.set_attr("epochs", epoch)
+        run_sp.set_attr("finalCriteria", criteria)
 
     if listener is not None:
         listener.on_iteration_terminated(carry)
@@ -207,6 +260,7 @@ def iterate_unbounded(
     listener: Optional[IterationListener] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_interval: Optional[int] = None,
+    job_key: Optional[str] = None,
 ) -> Iterable[Tuple[int, Any]]:
     """Host-driven online loop (Iterations.iterateUnboundedStreams:118-131).
 
@@ -231,14 +285,17 @@ def iterate_unbounded(
         from .. import config
 
         checkpoint_dir = config.iteration_checkpoint_dir
-        interval = config.iteration_checkpoint_interval
+        # an explicit interval wins even when the DIR comes from config —
+        # callers tuning snapshot cadence must not depend on where the
+        # directory was resolved from
+        interval = checkpoint_interval or config.iteration_checkpoint_interval
     else:
         interval = checkpoint_interval or 1
 
     state = init_state
     version = 0
     if checkpoint_dir is not None:
-        restored = load_iteration_checkpoint(checkpoint_dir, init_state)
+        restored = load_iteration_checkpoint(checkpoint_dir, init_state, job_key)
         if restored is not None:
             state, version, _ = restored
             # republish the restored model immediately so a serving model
@@ -249,17 +306,18 @@ def iterate_unbounded(
         if skip > 0:  # replayed prefix already folded into the checkpoint
             skip -= 1
             continue
-        state = step(state, batch)
+        with tracing.span("iteration.epoch", epoch=version, mode="unbounded"):
+            state = step(state, batch)
         version += 1
         if listener is not None:
             listener.on_epoch_watermark_incremented(version, state)
         if checkpoint_dir is not None and version % interval == 0:
-            save_iteration_checkpoint(checkpoint_dir, state, version, 0.0)
+            save_iteration_checkpoint(checkpoint_dir, state, version, 0.0, job_key)
         yield version, state
     if checkpoint_dir is not None:
         # the stream completed: clear the checkpoint so a NEW job reusing
         # this dir does not resume from (and skip past) a finished run
-        file = os.path.join(checkpoint_dir, "ckpt.npz")
+        file = _checkpoint_file(checkpoint_dir, job_key)
         if os.path.exists(file):
             os.remove(file)
     if listener is not None:

@@ -15,8 +15,9 @@ import os
 from typing import List, Sequence
 
 from .api import AlgoOperator, Estimator, Model, Stage
+from .obs import tracing
 from .table import Table
-from .utils import read_write
+from .utils import metrics, read_write
 
 
 def _transform_one(stage: Stage, table: Table) -> Table:
@@ -40,8 +41,15 @@ class PipelineModel(Model):
         if len(inputs) != 1:
             raise ValueError("PipelineModel.transform expects exactly 1 input table")
         table = inputs[0]
-        for stage in self._stages:
-            table = _transform_one(stage, table)
+        with metrics.timed("pipeline.transform"):
+            for i, stage in enumerate(self._stages):
+                with tracing.span(
+                    "pipeline.stage",
+                    index=i,
+                    stage=type(stage).__name__,
+                    op="transform",
+                ):
+                    table = _transform_one(stage, table)
         return [table]
 
     def save(self, path: str) -> None:
@@ -83,18 +91,29 @@ class Pipeline(Estimator):
                 last_estimator_idx = i
 
         model_stages: List[Stage] = []
-        for i, stage in enumerate(self._stages):
-            if isinstance(stage, Estimator):
-                model: Stage = stage.fit(table)
-            else:
-                model = stage
-            model_stages.append(model)
-            if i < last_estimator_idx:
-                if not isinstance(model, AlgoOperator):
-                    raise TypeError(
-                        f"Intermediate stage {type(stage).__name__} cannot transform data"
-                    )
-                table = _transform_one(model, table)
+        with metrics.timed("pipeline.fit"):
+            for i, stage in enumerate(self._stages):
+                # one span per stage slot covering the stage's fit AND its
+                # transform of the training data for downstream stages —
+                # the per-stage cost of this Pipeline.fit, which a bare
+                # stage.fit span would understate
+                with tracing.span(
+                    "pipeline.stage",
+                    index=i,
+                    stage=type(stage).__name__,
+                    op="fit",
+                ):
+                    if isinstance(stage, Estimator):
+                        model: Stage = stage.fit(table)
+                    else:
+                        model = stage
+                    model_stages.append(model)
+                    if i < last_estimator_idx:
+                        if not isinstance(model, AlgoOperator):
+                            raise TypeError(
+                                f"Intermediate stage {type(stage).__name__} cannot transform data"
+                            )
+                        table = _transform_one(model, table)
         return PipelineModel(model_stages)
 
     def save(self, path: str) -> None:

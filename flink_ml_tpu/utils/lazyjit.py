@@ -5,11 +5,24 @@ every call recompiles (seconds each over this environment's remote-compile
 tunnel). These helpers give the two needed shapes — a singleton kernel and
 a kernel family keyed by a static value — as one-liners, replacing the
 hand-rolled `global _X_JIT` caches that were spreading per module.
-"""
+
+Runtime accounting: each wrapper creation bumps the `jit.kernels` counter,
+and the first one installs the obs jax.monitoring hooks, so every actual
+XLA backend compile (including shape-driven recompiles of an existing
+wrapper) lands in `jit.compiles`/`jit.compile` and — when tracing is on —
+as a `category=compile` span (obs/tracing.py)."""
 
 from __future__ import annotations
 
 from typing import Callable, Dict, Tuple
+
+
+def _account_new_kernel() -> None:
+    from ..obs import tracing
+    from . import metrics
+
+    metrics.inc_counter("jit.kernels")
+    tracing.install_jax_hooks()  # jax is imported by the caller's next line
 
 
 def lazy_jit(fn: Callable, **jit_kwargs) -> Callable:
@@ -20,6 +33,7 @@ def lazy_jit(fn: Callable, **jit_kwargs) -> Callable:
         if not box:
             import jax
 
+            _account_new_kernel()
             box.append(jax.jit(fn, **jit_kwargs))
         return box[0](*args, **kwargs)
 
@@ -37,6 +51,7 @@ def keyed_jit(make_fn: Callable, **jit_kwargs) -> Callable:
         if fn is None:
             import jax
 
+            _account_new_kernel()
             fn = jax.jit(make_fn(*key), **jit_kwargs)
             cache[key] = fn
         return fn

@@ -79,6 +79,29 @@ def snapshot() -> Dict[str, Dict]:
     }
 
 
+def snapshot_delta(before: Dict[str, Dict], after: Dict[str, Dict]) -> Dict[str, Dict]:
+    """The registry activity between two `snapshot()` calls: timer and
+    counter increments (entries that did not move are dropped), gauges as
+    of `after`. The benchmark runner embeds this per entry so every BENCH
+    json carries its own span/readback/compile evidence."""
+    timers = {}
+    for name, stats in after["timers"].items():
+        prev = before["timers"].get(name, {"count": 0, "totalMs": 0.0})
+        count = stats["count"] - prev["count"]
+        if count:
+            timers[name] = {
+                "count": count,
+                "totalMs": stats["totalMs"] - prev["totalMs"],
+                "lastMs": stats["lastMs"],
+            }
+    counters = {}
+    for name, value in after["counters"].items():
+        delta = value - before["counters"].get(name, 0)
+        if delta:
+            counters[name] = delta
+    return {"timers": timers, "gauges": dict(after["gauges"]), "counters": counters}
+
+
 def reset() -> None:
     _timers.clear()
     _gauges.clear()

@@ -32,6 +32,10 @@ def packed_device_get(*arrays) -> List[np.ndarray]:
     import jax
     import jax.numpy as jnp
 
+    import time
+
+    from ..obs import tracing
+
     device_idx = [i for i, a in enumerate(arrays) if isinstance(a, jax.Array)]
     out: List = [None] * len(arrays)
     for i, a in enumerate(arrays):
@@ -41,7 +45,9 @@ def packed_device_get(*arrays) -> List[np.ndarray]:
         return out
     if len(device_idx) == 1:
         i = device_idx[0]
+        t0 = time.perf_counter()
         out[i] = np.asarray(jax.device_get(arrays[i]))
+        tracing.account_readback(out[i].nbytes, time.perf_counter() - t0)
         return out
     devs = [arrays[i] for i in device_idx]
     shapes = [a.shape for a in devs]
@@ -51,7 +57,11 @@ def packed_device_get(*arrays) -> List[np.ndarray]:
     for d in dtypes[1:]:
         dt = jnp.promote_types(dt, d)
     packed = jnp.concatenate([jnp.ravel(a).astype(dt) for a in devs])
+    t0 = time.perf_counter()
     host = np.asarray(jax.device_get(packed))
+    tracing.account_readback(
+        host.nbytes, time.perf_counter() - t0, arrays=len(device_idx)
+    )
     off = 0
     for i, shape, size, dtype in zip(device_idx, shapes, sizes, dtypes):
         out[i] = host[off : off + size].reshape(shape).astype(dtype)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""obs_report — render a span trace into per-stage/per-epoch breakdowns.
+
+Usage:
+    python scripts/obs_report.py TRACE.jsonl [options]
+
+Options:
+    --device-profile PATH   Cross-reference a jax.profiler trace (a
+                            profiler log dir or a *.trace.json.gz file)
+                            via traceprof.analyze_trace — device-busy time
+                            vs the host-side span accounting.
+    --max-epochs N          Rows to print in the epoch table (default 20;
+                            the TOTAL row always aggregates all epochs).
+    --json                  Emit the raw breakdown tables as JSON instead
+                            of text (for dashboards / CI assertions).
+
+Capture a trace by running any workload with
+`FLINK_ML_TPU_TRACE_FILE=/tmp/trace.jsonl` set, e.g.:
+
+    FLINK_ML_TPU_TRACE_FILE=/tmp/kmeans.jsonl python examples/kmeans_example.py
+    python scripts/obs_report.py /tmp/kmeans.jsonl
+
+The report splits each pipeline stage / training epoch into compute,
+collective, readback, compile and cache time (categories sum to the
+span's wall time — `compute` is the residual) and flags the dominant
+category. See docs/observability.md.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flink_ml_tpu.obs import report  # noqa: E402
+
+
+def main(argv):
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    trace_path = argv[0]
+    max_epochs = 20
+    if "--max-epochs" in argv:
+        max_epochs = int(argv[argv.index("--max-epochs") + 1])
+    records = report.load_trace(trace_path)
+    if not records:
+        print(f"No span records in {trace_path}.", file=sys.stderr)
+        return 1
+
+    if "--json" in argv:
+        trace = report.Trace(records)
+        payload = {
+            "stages": [
+                {
+                    "label": report._stage_label(r),
+                    "attrs": r.get("attrs", {}),
+                    **trace.breakdown(r),
+                }
+                for r in report.stage_records(trace)
+            ],
+            "epochs": [
+                {"attrs": r.get("attrs", {}), **trace.breakdown(r)}
+                for r in report.epoch_records(trace)
+            ],
+            "runs": [
+                {"attrs": r.get("attrs", {}), "wallUs": r.get("durUs", 0.0)}
+                for r in report.run_summaries(trace)
+            ],
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        print(f"Trace: {trace_path} ({len(records)} spans)\n")
+        print(report.render_report(records, max_epochs=max_epochs))
+
+    if "--device-profile" in argv:
+        profile = argv[argv.index("--device-profile") + 1]
+        print()
+        print(report.render_device_profile(profile))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

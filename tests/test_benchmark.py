@@ -87,7 +87,7 @@ class TestRunner:
         result = run_benchmark("KMeans-1", entry)
         assert set(result) == {
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
-            "outputRecordNum", "outputThroughput", "phaseTimesMs",
+            "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
         }
         assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}
         assert result["inputRecordNum"] == 200

@@ -183,6 +183,87 @@ def test_online_kmeans_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(res.weights, full.weights, rtol=0, atol=0)
 
 
+def test_job_key_namespacing_prevents_cross_restore(tmp_path):
+    """Two jobs with IDENTICAL carry structure (same k and d) but different
+    hyper-parameters sharing one checkpoint dir must not cross-restore —
+    the param-hash job key namespaces the checkpoint files (ADVICE round 5:
+    the structural guard alone cannot tell these jobs apart)."""
+    from flink_ml_tpu.models.clustering.onlinekmeans import (
+        OnlineKMeans,
+        generate_random_model_data,
+    )
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(400, 3).astype(np.float64)
+    init = generate_random_model_data(k=2, dim=3, weight=1.0, seed=0)
+
+    # uninterrupted reference run of job B (decay 0.9)
+    full_b = (
+        OnlineKMeans().set_global_batch_size(100).set_decay_factor(0.9)
+        .set_initial_model_data(init).fit(_replayable_stream(X, chunk=50))
+    )
+    full_b.process_updates()
+
+    ckpt = str(tmp_path / "shared")
+    with config.iteration_checkpointing(ckpt):
+        # job A (decay 0.1) stops mid-stream, leaving a checkpoint behind
+        a = (
+            OnlineKMeans().set_global_batch_size(100).set_decay_factor(0.1)
+            .set_initial_model_data(init).fit(_replayable_stream(X, chunk=50))
+        )
+        a.process_updates(max_batches=2)
+        assert a.model_version == 2
+        # job B shares the dir but must start from scratch, not from A
+        b = (
+            OnlineKMeans().set_global_batch_size(100).set_decay_factor(0.9)
+            .set_initial_model_data(init).fit(_replayable_stream(X, chunk=50))
+        )
+        b.process_updates()
+    assert b.model_version == 4
+    np.testing.assert_allclose(b.centroids, full_b.centroids, rtol=0, atol=0)
+
+
+def test_checkpoint_job_key_stability():
+    from flink_ml_tpu.models.clustering.onlinekmeans import OnlineKMeans
+    from flink_ml_tpu.parallel.iteration import checkpoint_job_key
+
+    a = OnlineKMeans().set_decay_factor(0.5)
+    same = OnlineKMeans().set_decay_factor(0.5)
+    other = OnlineKMeans().set_decay_factor(0.9)
+    assert checkpoint_job_key(a) == checkpoint_job_key(same)
+    assert checkpoint_job_key(a) != checkpoint_job_key(other)
+    assert checkpoint_job_key(a).startswith("OnlineKMeans-")
+    # termination-schedule params are excluded: raising maxIter to resume
+    # an interrupted bounded run maps to the SAME job
+    lr5 = LogisticRegression().set_max_iter(5)
+    lr20 = LogisticRegression().set_max_iter(20)
+    assert checkpoint_job_key(lr5) == checkpoint_job_key(lr20)
+
+
+def test_unbounded_explicit_interval_wins_over_config(tmp_path):
+    """An explicit checkpoint_interval is honored even when the directory
+    comes from the process-wide config (previously the config interval
+    silently won)."""
+    import os
+
+    from flink_ml_tpu.parallel.iteration import iterate_unbounded
+
+    ckpt = str(tmp_path / "interval")
+    with config.iteration_checkpointing(ckpt, interval=1):
+        versions_seen = []
+        for version, state in iterate_unbounded(
+            iter([1.0, 2.0, 3.0]),
+            lambda s, b: s + b,
+            0.0,
+            checkpoint_interval=5,  # larger than the stream: never snapshots
+            job_key="job-x",
+        ):
+            versions_seen.append(version)
+            # interval=5 means no checkpoint may appear at versions 1..3
+            assert not os.listdir(ckpt) if os.path.isdir(ckpt) else True
+    assert versions_seen == [1, 2, 3]
+
+
 def test_corrupt_checkpoint_is_ignored(tmp_path):
     import os
 

@@ -114,6 +114,48 @@ class TestNaiveBayes:
                 Table({"features": jax.device_put(X), "label": jax.device_put(y)})
             )
 
+    def test_nan_feature_raises_device_and_host(self):
+        """A NaN feature can never be matched at predict time (NaN != NaN)
+        and silently inflates the device category sets — rejected at fit
+        time on both paths like NaN labels."""
+        import jax
+
+        X = np.zeros((8, 2), np.float32)
+        X[3, 1] = np.nan
+        y = np.asarray([0, 1] * 4, np.float32)
+        with pytest.raises(ValueError, match="Feature column contains null/NaN"):
+            NaiveBayes().fit(
+                Table({"features": jax.device_put(X), "label": jax.device_put(y)})
+            )
+        with pytest.raises(ValueError, match="Feature column contains null/NaN"):
+            NaiveBayes().fit(
+                Table({"features": X.astype(np.float64), "label": y.astype(np.float64)})
+            )
+
+    def test_inf_category_stays_exact(self):
+        """+inf doubles as the device kernels' category-padding sentinel, so
+        a trained +inf category must route fit AND predict through the host
+        path instead of co-counting/scoring against padding slots."""
+        import jax
+
+        X = np.zeros((12, 2), np.float32)
+        X[:, 1] = np.asarray([0, 1, np.inf] * 4, np.float32)
+        y = np.asarray([0, 1] * 6, np.float32)
+        host = NaiveBayes().fit(
+            Table({"features": X.astype(np.float64), "label": y.astype(np.float64)})
+        )
+        dev = NaiveBayes().fit(
+            Table({"features": jax.device_put(X), "label": jax.device_put(y)})
+        )
+        for i in range(2):
+            for j in range(2):
+                assert dev.theta[i][j] == pytest.approx(host.theta[i][j])
+        pred_h = np.asarray(host.transform(Table({"features": X}))[0].column("prediction"))
+        pred_d = np.asarray(
+            dev.transform(Table({"features": jax.device_put(X)}))[0].column("prediction")
+        )
+        np.testing.assert_array_equal(pred_h, pred_d)
+
     def test_save_load(self, tmp_path):
         model = NaiveBayes().fit(self._train())
         model.save(str(tmp_path / "nb"))

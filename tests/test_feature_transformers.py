@@ -88,6 +88,45 @@ class TestBucketizer:
         with pytest.raises(ValueError):
             Bucketizer().set_splits_array([[0.0, 1.0]])
 
+    def test_device_inexact_splits_match_host(self):
+        """Splits that do not survive the float32 cast (e.g. 1 + 1e-10)
+        would move boundary values into the wrong bucket on device — the
+        column must fall back to the exact host path and bucket identically
+        to a host column."""
+        import jax
+
+        boundary = 1.0 + 1e-10  # float32 rounds this down to exactly 1.0
+        splits = [[0.0, boundary, 2.0]]
+        values = np.asarray([0.5, 1.0, 1.5], np.float32)
+        op = (
+            Bucketizer()
+            .set_input_cols("x")
+            .set_output_cols("o")
+            .set_splits_array(splits)
+            .set_handle_invalid("keep")
+        )
+        host = op.transform(Table({"x": values.astype(np.float64)}))[0]
+        dev = op.transform(Table({"x": jax.device_put(values)}))[0]
+        # 1.0 < 1.0000000001 → bucket 0 (the f32 device compare would say 1)
+        np.testing.assert_array_equal(np.asarray(host.column("o")), [0, 0, 1])
+        np.testing.assert_array_equal(
+            np.asarray(dev.column("o")), np.asarray(host.column("o"))
+        )
+
+    def test_device_exact_splits_stay_on_device(self):
+        import jax
+
+        values = jax.device_put(np.asarray([0.5, 1.0, 1.5], np.float32))
+        out = (
+            Bucketizer()
+            .set_input_cols("x")
+            .set_output_cols("o")
+            .set_splits_array([[0.0, 1.0, 2.0]])
+            .set_handle_invalid("keep")
+        ).transform(Table({"x": values}))[0]
+        assert isinstance(out.column("o"), jax.Array)  # no host fallback
+        np.testing.assert_array_equal(np.asarray(out.column("o")), [0, 1, 1])
+
 
 class TestNormalizer:
     def test_l2(self):
