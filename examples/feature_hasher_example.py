@@ -1,0 +1,33 @@
+"""FeatureHasher mixed numeric/categorical hashing into a fixed-width
+vector (reference: pyflink/examples/ml/feature/featurehasher_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.featurehasher import FeatureHasher
+
+t = Table(
+    {
+        "f0": ["a", "b", "a"],
+        "f1": [1.1, 0.0, 2.5],
+        "f2": [True, False, True],
+    }
+)
+out = (
+    FeatureHasher()
+    .set_input_cols("f0", "f1", "f2")
+    .set_categorical_cols("f0")
+    .set_output_col("vec")
+    .set_num_features(64)
+    .transform(t)[0]
+)
+vecs = np.stack([np.asarray(row["vec"].to_array()) for row in out.collect()])
+for v in vecs:
+    print(np.nonzero(v)[0], v[np.nonzero(v)[0]])
+assert vecs.shape == (3, 64)
+# rows 0 and 2 share the categorical bucket for f0=a and the boolean
+# bucket for f2=true; each row hashes at most one bucket per column
+assert (np.count_nonzero(vecs, axis=1) <= 3).all()
+np.testing.assert_array_equal(
+    np.nonzero(vecs[0])[0][:1], np.nonzero(vecs[2])[0][:1]
+)

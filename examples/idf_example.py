@@ -1,0 +1,27 @@
+"""IDF inverse-document-frequency weighting over term-count vectors
+(reference: pyflink/examples/ml/feature/idf_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.idf import IDF
+
+# rows are documents, columns are terms (e.g. HashingTF / CountVectorizer
+# output); term 0 appears in every document, term 2 in only one
+counts = np.array(
+    [
+        [1.0, 1.0, 0.0],
+        [1.0, 0.0, 0.0],
+        [2.0, 1.0, 1.0],
+    ]
+)
+t = Table({"input": counts})
+model = IDF().set_input_col("input").set_output_col("output").fit(t)
+out = model.transform(t)[0]
+print("idf:", model.idf)
+print(np.asarray(out.column("output")))
+# IDF(t) = log((n+1) / (df+1)): the everywhere-term gets the smallest
+# weight, the rarest term the largest
+expected = np.log((3.0 + 1.0) / (np.array([3.0, 2.0, 1.0]) + 1.0))
+np.testing.assert_allclose(model.idf, expected, atol=1e-12)
+np.testing.assert_allclose(np.asarray(out.column("output")), counts * expected)

@@ -1,0 +1,22 @@
+"""NGram sliding-window token joining (reference:
+pyflink/examples/ml/feature/ngram_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.ngram import NGram
+
+t = Table(
+    {
+        "input": [
+            [],
+            ["a", "b", "c"],
+            ["a", "b", "c", "d"],
+        ]
+    }
+)
+out = NGram().set_n(2).set_input_col("input").set_output_col("output").transform(t)[0]
+for row in out.collect():
+    print(list(row["input"]), "->", list(row["output"]))
+rows = out.collect()
+assert list(rows[0]["output"]) == []
+assert list(rows[1]["output"]) == ["a b", "b c"]
+assert list(rows[2]["output"]) == ["a b", "b c", "c d"]

@@ -1,0 +1,16 @@
+"""Normalizer p-norm row scaling (reference:
+pyflink/examples/ml/feature/normalizer_example.py)."""
+
+import numpy as np
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.normalizer import Normalizer
+
+X = np.array([[3.0, 4.0], [0.0, 5.0], [6.0, 8.0]])
+out = (
+    Normalizer().set_p(2.0).set_input_col("input").set_output_col("output")
+    .transform(Table({"input": X}))[0]
+)
+normalized = np.asarray(out.column("output"))
+print(normalized)
+np.testing.assert_allclose(np.linalg.norm(normalized, axis=1), 1.0, atol=1e-6)

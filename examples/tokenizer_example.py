@@ -1,0 +1,13 @@
+"""Tokenizer lowercase whitespace splitting (reference:
+pyflink/examples/ml/feature/tokenizer_example.py)."""
+
+from flink_ml_tpu import Table
+from flink_ml_tpu.models.feature.tokenizer import Tokenizer
+
+t = Table({"input": ["Test of Tokenize", "Another Test"]})
+out = Tokenizer().set_input_col("input").set_output_col("output").transform(t)[0]
+for row in out.collect():
+    print(row["input"], "->", list(row["output"]))
+rows = out.collect()
+assert list(rows[0]["output"]) == ["test", "of", "tokenize"]
+assert list(rows[1]["output"]) == ["another", "test"]

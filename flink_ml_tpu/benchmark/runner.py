@@ -135,6 +135,7 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         num_output = sum(t.num_rows for t in outputs)
     elapsed_ms = (time.perf_counter() - start) * 1000.0
 
+    delta = metrics.snapshot_delta(metrics_before, metrics.snapshot())
     return {
         "name": name,
         "totalTimeMs": elapsed_ms,
@@ -143,7 +144,13 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "outputRecordNum": num_output,
         "outputThroughput": num_output * 1000.0 / elapsed_ms if elapsed_ms else 0.0,
         "phaseTimesMs": {k: v * 1000.0 for k, v in phases.items()},
-        "metrics": metrics.snapshot_delta(metrics_before, metrics.snapshot()),
+        # first-class dispatch-pipeline fields (also inside metrics):
+        # blocking host↔device syncs this entry paid, and the in-flight
+        # chunk depth its pipelined loops ran at — a sync-count jump
+        # between BENCH files is a dispatch regression
+        "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
+        "dispatchDepth": int(delta["gauges"].get("iteration.dispatch_depth", 0)),
+        "metrics": delta,
     }
 
 

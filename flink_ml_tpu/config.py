@@ -9,11 +9,66 @@ pick up cluster configuration.
 
 from __future__ import annotations
 
+import os
 from contextlib import contextmanager
 from typing import Optional
 
 iteration_checkpoint_dir: Optional[str] = None
 iteration_checkpoint_interval: int = 1
+
+# --- dispatch pipeline (parallel/dispatch.py) ---------------------------------
+# Epochs fused into one device program by the host-driven iteration loops
+# (the reference batches per-epoch progress the same way with its epoch
+# watermarks + chunked all-reduce). None = adaptive: ~maxIter/8 clamped to
+# [1, 32], so short runs keep per-epoch visibility and long runs amortize
+# the dispatch+readback round trip over many epochs.
+iteration_chunk_size: Optional[int] = None
+# Max dispatched-but-undrained chunks per loop. Depth > 1 lets host Python
+# run ahead of the device instead of serializing on every chunk's
+# convergence readback; tol semantics stay exact because speculative
+# chunks are criteria-guarded no-ops once tol has fired.
+iteration_dispatch_depth: int = 2
+
+
+def iteration_chunk_for(max_iter: int, chunk_size: Optional[int] = None) -> int:
+    """Resolve the epoch-chunk length K for a loop of `max_iter` epochs:
+    explicit argument > process-wide `iteration_chunk_size` > adaptive."""
+    k = chunk_size if chunk_size is not None else iteration_chunk_size
+    if k is None:
+        k = max(1, min(32, -(-max_iter // 8)))
+    return max(1, min(int(k), max(1, int(max_iter))))
+
+
+# --- persistent XLA compilation cache ----------------------------------------
+# Cold-start killer: compiled executables survive process restarts, so the
+# first fit of a new process reuses the previous process's XLA programs
+# (sparseWideLR cold 2.3 s / kmeans cold 936 ms in BENCH_r05 are almost
+# entirely backend compiles). Opt-in via enable_compilation_cache() or the
+# FLINK_ML_TPU_COMPILATION_CACHE_DIR env var.
+compilation_cache_dir: Optional[str] = None
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at `path` (default:
+    `.jax_cache` under the current working directory). Returns the
+    directory in use, or None when jax refuses the option (ancient jax)."""
+    global compilation_cache_dir
+    path = path or os.path.join(os.getcwd(), ".jax_cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # every kernel here is worth persisting — the hot loops are small
+        # programs that compile in well under the default 1s threshold
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        return None
+    compilation_cache_dir = path
+    return path
+
+
+if os.environ.get("FLINK_ML_TPU_COMPILATION_CACHE_DIR"):
+    enable_compilation_cache(os.environ["FLINK_ML_TPU_COMPILATION_CACHE_DIR"])
 
 # Spillable data-cache defaults for training on StreamTable inputs (the
 # analogue of `iteration.data-cache.path` + managed-memory weights in the

@@ -109,13 +109,14 @@ def run_sgd(
         table, params.get_features_col(), params.get_label_col(), weight_col,
         keep_sparse=True,
     )
-    flag = None
+    validate_on_device = False
     if validate_binomial:
         if isinstance(y, jax.Array):
-            # device labels: compute the validity flag on device and read it
-            # back fused with the training result — a standalone bool() here
-            # would cost its own host round trip before training even starts
-            flag = _labels_ok(y)
+            # device labels: the {0,1} validity check is computed INSIDE the
+            # training program and read back fused with the packed training
+            # result — a standalone bool() here would cost its own host
+            # round trip before training even starts
+            validate_on_device = True
         else:
             validate_binomial_labels(y)
     if isinstance(X, tuple):  # sparse: train on padded CSR, no densify
@@ -125,8 +126,10 @@ def run_sgd(
         init_coeff = np.zeros(dim, dtype=np.float64)
     else:
         init_coeff = np.zeros(X.shape[1], dtype=np.float64)
-    result = optimizer.optimize_async(init_coeff, X, y, w, loss_func)
-    flag_val, coeff, criteria, epochs = read_train_result(result, flag=flag)
+    result = optimizer.optimize_async(
+        init_coeff, X, y, w, loss_func, validate_labels=validate_on_device
+    )
+    flag_val, coeff, criteria, epochs = read_train_result(result)
     _raise_if_invalid(flag_val)
     return coeff, criteria, epochs
 

@@ -257,6 +257,12 @@ def _cluster_block(X, linkage, measure, num_clusters, threshold, compute_full_tr
 
 
 class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
+    # the linkage matrix is built row-by-row on host (no device kernels at
+    # all), so device-born input costs a full D2H pull of the dataset
+    # before any work starts — the slowest per-record entry in round 5's
+    # SWEEP was exactly that ~100ms tunnel pull, not the clustering
+    prefers_host_input = True
+
     @staticmethod
     def _window_row_groups(table: Table, n: int, windows) -> List[np.ndarray]:
         """Row-index groups each LOCAL clustering runs over, per window

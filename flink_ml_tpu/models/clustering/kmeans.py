@@ -29,7 +29,7 @@ from ...common.param import (
     HasPredictionCol,
     HasSeed,
 )
-from ...ops.distance import DistanceMeasure
+from ...ops.distance import DistanceMeasure, jit_find_closest
 from ...param import IntParam, ParamValidators, StringParam
 from ...parallel import mesh as mesh_lib
 from ...table import Table, as_dense_matrix
@@ -62,8 +62,7 @@ class KMeansParams(KMeansModelParams, HasSeed, HasMaxIter):
         return self.set(self.INIT_MODE, value)
 
 
-@partial(jax.jit, static_argnames=("measure_name",))
-def _lloyd_train(X, weights, init_centroids, max_iter, measure_name):
+def _lloyd_train_impl(X, weights, init_centroids, max_iter, measure_name):
     """The full Lloyd loop as one XLA program; X is (n, d) sharded over the
     data axis, the segment-sum contraction over n makes XLA reduce over ICI.
     Data and max_iter are runtime arguments so repeated fits with the same
@@ -90,6 +89,16 @@ def _lloyd_train(X, weights, init_centroids, max_iter, measure_name):
     init = (init_centroids, jnp.zeros(init_centroids.shape[0], X.dtype), jnp.asarray(0, jnp.int32))
     centroids, counts, _ = jax.lax.while_loop(cond, step, init)
     return centroids, counts
+
+
+_lloyd_train = jax.jit(_lloyd_train_impl, static_argnames=("measure_name",))
+# Donating variant for fit-owned buffers: the staged/padded dataset, the
+# synthesized unit weights, and the initial centroids are all consumed by
+# the train loop, so XLA may reuse their HBM in place instead of holding a
+# second copy for the duration of the fit.
+_lloyd_train_donating = jax.jit(
+    _lloyd_train_impl, static_argnames=("measure_name",), donate_argnums=(0, 1, 2)
+)
 
 
 class KMeansModel(Model, KMeansModelParams):
@@ -124,8 +133,7 @@ class KMeansModel(Model, KMeansModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        measure = DistanceMeasure.get_instance(self.get_distance_measure())
-        assign = jax.jit(measure.find_closest)(
+        assign = jit_find_closest(self.get_distance_measure())(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
         )
         if not isinstance(X, jax.Array):  # host in -> host out
@@ -230,10 +238,19 @@ class KMeans(Estimator, KMeansParams):
         # the Lloyd loop is one on-device while_loop (always maxIter
         # epochs): no per-epoch host boundary exists, so a single
         # `iteration.run` span carries the per-run summary
+        from ...parallel import dispatch
+
+        # the staged/padded points, synthesized weights, and gathered init
+        # centroids are all fit-owned buffers consumed by the train loop —
+        # donate them so Lloyd ping-pongs in the same HBM instead of
+        # holding a second copy of the dataset for the whole fit
+        train = (
+            _lloyd_train_donating if dispatch.supports_donation() else _lloyd_train
+        )
         with tracing.span(
             "iteration.run", mode="device", epochs=self.get_max_iter()
         ):
-            centroids, counts = _lloyd_train(
+            centroids, counts = train(
                 X_dev,
                 w_dev,
                 init_centroids,
@@ -304,28 +321,50 @@ class KMeans(Estimator, KMeansParams):
         row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         centroids = jnp.asarray(init)
         measure = self.get_distance_measure()
-        for _ in range(self.get_max_iter()):
-            sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
-            counts = jnp.zeros((k,), jnp.float32)
-            for t in replay:
-                X = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
-                rows = X.shape[0]
-                X_pad, _ = mesh_lib.pad_to_multiple(X, shards)
-                w = np.zeros(X_pad.shape[0], np.float32)
-                w[:rows] = 1.0
-                s, c = _accumulate_batch(
-                    jax.device_put(X_pad, mat_sharding),
-                    jax.device_put(w, row_sharding),
-                    centroids,
-                    measure,
-                )
-                sums = sums + s
-                counts = counts + c
-            centroids = jnp.where(
-                counts[:, None] > 0,
-                sums / jnp.maximum(counts[:, None], 1e-30),
-                centroids,
+
+        # Single-worker prefetch (native cache access stays serial, like
+        # the SGD stream loop): the worker reads + pads + uploads batch
+        # i+1 while the device accumulates batch i, so cache/disk reads
+        # and H2D transfers ride under the assignment contractions — the
+        # overlap DataCacheReader gets from Flink's async mailbox.
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(it):
+            t = next(it, None)
+            if t is None:
+                return None
+            X = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
+            rows = X.shape[0]
+            X_pad, _ = mesh_lib.pad_to_multiple(X, shards)
+            w = np.zeros(X_pad.shape[0], np.float32)
+            w[:rows] = 1.0
+            return (
+                jax.device_put(X_pad, mat_sharding),
+                jax.device_put(w, row_sharding),
             )
+
+        executor = ThreadPoolExecutor(max_workers=1)
+        try:
+            for _ in range(self.get_max_iter()):
+                sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
+                counts = jnp.zeros((k,), jnp.float32)
+                it = iter(replay)
+                fut = executor.submit(fetch, it)
+                while True:
+                    batch = fut.result()
+                    if batch is None:
+                        break
+                    fut = executor.submit(fetch, it)
+                    s, c = _accumulate_batch(*batch, centroids, measure)
+                    sums = sums + s
+                    counts = counts + c
+                centroids = jnp.where(
+                    counts[:, None] > 0,
+                    sums / jnp.maximum(counts[:, None], 1e-30),
+                    centroids,
+                )
+        finally:
+            executor.shutdown(wait=True, cancel_futures=True)
 
         from ...utils.packing import packed_device_get
 

@@ -30,7 +30,7 @@ from ...common.param import (
     HasPredictionCol,
     HasSeed,
 )
-from ...ops.distance import DistanceMeasure
+from ...ops.distance import DistanceMeasure, jit_find_closest
 from ...parallel.iteration import iterate_unbounded
 from ...table import StreamTable, Table, as_dense_matrix
 from ...utils import read_write
@@ -146,8 +146,7 @@ class OnlineKMeansModel(Model, KMeansModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()))
-        measure = DistanceMeasure.get_instance(self.get_distance_measure())
-        assign = jax.jit(measure.find_closest)(
+        assign = jit_find_closest(self.get_distance_measure())(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
         )
         return [

@@ -20,6 +20,9 @@ from ...api import Transformer
 from ...common.param import HasInputCol, HasOutputCol
 from ...param import BooleanParam
 from ...table import Table, as_dense_matrix
+from ...utils.lazyjit import lazy_jit
+
+_matmul = lazy_jit(jnp.matmul)
 
 
 class DCTParams(HasInputCol, HasOutputCol):
@@ -53,7 +56,7 @@ class DCT(Transformer, DCTParams):
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         B = _dct_basis(X.shape[1])
         mat = B.T if self.get_inverse() else B
-        out = jax.jit(jnp.matmul)(jnp.asarray(X, jnp.float32), jnp.asarray(mat.T, jnp.float32))
+        out = _matmul(jnp.asarray(X, jnp.float32), jnp.asarray(mat.T, jnp.float32))
         if not isinstance(X, jax.Array):
             out = np.asarray(out)
         return [table.with_column(self.get_output_col(), out)]

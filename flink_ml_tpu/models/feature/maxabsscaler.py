@@ -17,7 +17,10 @@ from ...api import Estimator, Model
 from ...common.param import HasInputCol, HasOutputCol
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
+
+_col_max_abs = lazy_jit(lambda a: jnp.max(jnp.abs(a), axis=0))
 
 
 class MaxAbsScalerParams(HasInputCol, HasOutputCol):
@@ -62,9 +65,7 @@ class MaxAbsScaler(Estimator, MaxAbsScalerParams):
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         from ...utils.packing import packed_device_get
 
-        (max_abs,) = packed_device_get(
-            jax.jit(lambda a: jnp.max(jnp.abs(a), axis=0))(jnp.asarray(X))
-        )
+        (max_abs,) = packed_device_get(_col_max_abs(jnp.asarray(X)))
         model = MaxAbsScalerModel()
         model.max_abs = np.asarray(max_abs, dtype=np.float64)
         update_existing_params(model, self)

@@ -22,6 +22,7 @@ See docs/observability.md for the full surface and a worked example.
 """
 
 from .tracing import (  # noqa: F401
+    account_host_sync,
     add_attr,
     configure,
     current_span,
@@ -29,5 +30,6 @@ from .tracing import (  # noqa: F401
     enabled,
     event,
     install_jax_hooks,
+    set_dispatch_depth,
     span,
 )

@@ -242,6 +242,23 @@ def account_readback(nbytes: int, seconds: float, arrays: int = 1) -> None:
         )
 
 
+def account_host_sync(kind: str = "drain", count: int = 1) -> None:
+    """Fold one blocking host↔device synchronization point into the
+    registry: a convergence-scalar drain, a packed fit-result readback, a
+    checkpoint carry pull. `host_sync_count` is THE dispatch-pipeline
+    regression metric — on a remote-attached TPU every sync is a full
+    tunnel round trip, so a loop that syncs O(maxIter) times instead of
+    O(maxIter/K) is visible as a counter jump in any BENCH delta."""
+    metrics.inc_counter("iteration.host_sync", count)
+    metrics.inc_counter(f"iteration.host_sync.{kind}", count)
+
+
+def set_dispatch_depth(depth: int) -> None:
+    """Record the in-flight dispatch depth a pipelined loop ran at (gauge;
+    embedded in BENCH entry deltas next to host_sync_count)."""
+    metrics.set_gauge("iteration.dispatch_depth", depth)
+
+
 _jax_hooks_installed = False
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 

@@ -64,3 +64,14 @@ class CosineDistanceMeasure(DistanceMeasure):
         cn = jnp.linalg.norm(C, axis=1)[None, :]
         sim = (X @ C.T) / jnp.maximum(xn * cn, 1e-12)
         return 1.0 - sim
+
+
+from ..utils.lazyjit import keyed_jit  # noqa: E402
+
+# One jitted find_closest kernel per measure name, created once at first
+# use. `jax.jit(measure.find_closest)` at each transform call would build a
+# fresh wrapper (and retrace) per call — the lazyjit keying audit moved
+# every such per-call wrapper to a module-level cache.
+jit_find_closest = keyed_jit(
+    lambda name: DistanceMeasure.get_instance(name).find_closest
+)

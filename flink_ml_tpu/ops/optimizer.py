@@ -140,23 +140,70 @@ def _update_model(coeff, grad, wsum, lr, reg, elastic_net):
     return lax.cond(wsum > 0, do_update, lambda c: c, coeff)
 
 
-@partial(jax.jit, static_argnames=("loss_func", "batch", "has_weights"))
-def _sgd_train_flat(
-    X, y, w, init_coeff, loss_func, batch, has_weights, n, max_iter, tol, lr, reg, elastic_net
-):
+def _binomial_labels_ok(y):
+    """{0,1} label validity flag (LogisticRegression.java:78-87), fused
+    into the training program so validation rides the fit's single packed
+    readback instead of costing its own host round trip. Weight-0 padding
+    rows carry label 0.0, which passes the check by construction."""
+    return jnp.all((y == 0.0) | (y == 1.0)).astype(jnp.float32)
+
+
+def _unpack_hyper(hyper, dtype):
+    """(max_iter, tol, lr, reg, elastic_net) views of the packed f32
+    hyper-parameter vector. One small H2D transfer replaces five scalar
+    uploads per fit — on a remote-attached TPU every host→device buffer
+    is its own tunnel operation."""
+    return (
+        hyper[0].astype(jnp.int32),
+        hyper[1],
+        hyper[2].astype(dtype),
+        hyper[3].astype(dtype),
+        hyper[4].astype(dtype),
+    )
+
+
+def _pack_train_result(coeff, criteria, epochs, flag=None, pack_sharding=None):
+    """Fuse (flag?, coeff, criteria, epochs) into ONE flat array INSIDE the
+    training program, so the host reads everything back in a single
+    transfer. Packs in at least float32 so integer epoch counts stay exact
+    under low-precision compute dtypes. With `pack_sharding` every part is
+    first constrained to one (replicated) layout: GSPMD miscompiles a
+    concatenate of differently-sharded parts on a multi-axis mesh into a
+    cross-data-shard partial-sum (each value comes back multiplied by the
+    data-axis size) — the constraint forces the all-gather first."""
+    dt = jnp.promote_types(coeff.dtype, jnp.float32)
+    parts = [
+        coeff.astype(dt),
+        jnp.reshape(jnp.asarray(criteria).astype(dt), (1,)),
+        jnp.reshape(jnp.asarray(epochs).astype(dt), (1,)),
+    ]
+    if flag is not None:
+        parts.insert(0, jnp.reshape(flag.astype(dt), (1,)))
+    if pack_sharding is not None:
+        parts = [lax.with_sharding_constraint(p, pack_sharding) for p in parts]
+    return jnp.concatenate(parts)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("loss_func", "batch", "has_weights", "check_labels"),
+)
+def _sgd_train_flat(X, y, w, init_coeff, loss_func, batch, has_weights, n, hyper, check_labels):
     """Single-data-shard variant of `_sgd_train` that slices each epoch's
     batch straight out of the FLAT row-major arrays with a dynamic slice.
 
     The batched (num_batches, B, d) layout exists so every batch spans all
     data shards; with one data shard it is a pure 4GB copy program on the
     critical path (measured ~130ms of the benchmark fit on the remote
-    tunnel). Here the only programs in the fit chain are this train loop
-    and the result pack. Rows are pre-padded to a batch multiple; absent
+    tunnel). Here the only program in the fit chain is this train loop —
+    the result pack and (for classifiers) the label-validity check are
+    fused into it. Rows are pre-padded to a batch multiple; absent
     weights are synthesized in-loop as (row_index < n) so padding rows
     contribute nothing and no separate weights program runs."""
     num_batches = y.shape[0] // batch
     d = init_coeff.shape[0]
     dtype = _feature_dtype(X)
+    max_iter, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
 
     def cond(state):
         _, _, _, epoch, criteria = state
@@ -186,21 +233,24 @@ def _sgd_train_flat(
     )
     coeff, grad, wsum, epochs, criteria = lax.while_loop(cond, body, init_state)
     coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
-    return coeff, criteria, epochs
+    flag = _binomial_labels_ok(y) if check_labels else None
+    return _pack_train_result(coeff, criteria, epochs, flag)
 
 
-@partial(jax.jit, static_argnames=("loss_func",))
-def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, elastic_net):
+@partial(jax.jit, static_argnames=("loss_func", "check_labels", "pack_sharding"))
+def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, hyper, check_labels, pack_sharding):
     """The full bounded training iteration as one XLA program.
 
     State machine mirrors SGD.java's CacheDataAndDoTrain: each epoch first
     applies the gradient reduced in the previous epoch, then computes the
     gradient of the next batch; one extra update lands after termination.
-    Returns (final_coeff, final_loss, num_epochs).
+    Returns the packed [flag?, coeff, criteria, epochs] result vector
+    (`unpack_train_result` is the host-side inverse).
     """
     num_batches = y_b.shape[0]
     d = init_coeff.shape[0]
     dtype = _feature_dtype(X_b)
+    max_iter, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
 
     def cond(state):
         _, _, _, epoch, criteria = state
@@ -226,7 +276,8 @@ def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, max_iter, tol, lr, reg, ela
     )
     coeff, grad, wsum, epochs, criteria = lax.while_loop(cond, body, init_state)
     coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
-    return coeff, criteria, epochs
+    flag = _binomial_labels_ok(y_b) if check_labels else None
+    return _pack_train_result(coeff, criteria, epochs, flag, pack_sharding)
 
 
 def _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
@@ -242,65 +293,99 @@ def _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
     return (coeff, grad, wsum, epoch + 1), jnp.asarray(criteria, jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("loss_func",))
-def _stream_epoch(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net):
+def _stream_epoch_impl(Xk, yk, wk, carry, criteria, loss_func, hyper):
     """Out-of-core epoch: the batch arrives as an argument (read back from
     the spillable data cache) instead of being indexed out of a resident
-    (num_batches, B, d) array — only one batch ever occupies HBM."""
-    return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
+    (num_batches, B, d) array — only one batch ever occupies HBM.
+
+    Criteria-guarded so the host may dispatch stream epochs ahead of their
+    convergence readbacks: once `criteria <= tol` the program is an
+    identity on (carry, criteria), exactly like a chunk dispatched past
+    the tol-fire epoch. Returns (carry, criteria, packed[epoch, criteria])."""
+    dtype = _feature_dtype(Xk)
+    _, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+
+    def run(args):
+        c, _ = args
+        return _epoch_step(Xk, yk, wk, c, loss_func, lr, reg, elastic_net)
+
+    def skip(args):
+        return args
+
+    carry, criteria = lax.cond(criteria > tol, run, skip, (carry, criteria))
+    packed = jnp.stack([carry[3].astype(jnp.float32), criteria])
+    return carry, criteria, packed
 
 
-@jax.jit
-def _pack_result(coeff, criteria, epochs, flag=None):
-    """Fuse (coeff, criteria, epochs[, leading flag]) into ONE flat array so
-    the host reads everything back in a single transfer. On remote-attached
-    TPUs each output array's first readback is a full host round trip
-    (~100ms over the tunnel), so a 3-output result costs 3x the latency of
-    a packed one — this was the dominant cost of the whole benchmark fit.
-    Packs in at least float32 so integer epoch counts stay exact under
-    low-precision compute dtypes (bfloat16 is exact only to 256)."""
-    dt = jnp.promote_types(coeff.dtype, jnp.float32)
-    parts = [
-        coeff.astype(dt),
-        jnp.reshape(jnp.asarray(criteria).astype(dt), (1,)),
-        jnp.reshape(jnp.asarray(epochs).astype(dt), (1,)),
-    ]
-    if flag is not None:
-        parts.insert(0, jnp.reshape(flag.astype(dt), (1,)))
-    return jnp.concatenate(parts)
+# Borrowing variant for epochs whose post-state must stay readable on host
+# (checkpoint snapshot pending); donating variant ping-pongs the carry in
+# place in HBM (carry and criteria are argnums 3 and 4).
+_stream_epoch = jax.jit(_stream_epoch_impl, static_argnames=("loss_func",))
+_stream_epoch_donating = jax.jit(
+    _stream_epoch_impl, static_argnames=("loss_func",), donate_argnums=(3, 4)
+)
+
+
+def _sgd_chunk_impl(X_b, y_b, w_b, carry, criteria, loss_func, hyper, chunk_end):
+    """Up to `chunk_end - carry.epoch` host-driven epochs fused into ONE
+    device program, for the checkpointed train loop: the tol check runs
+    every epoch inside the while condition (same order as the per-epoch
+    loop, so the stop epoch is identical for any chunk size), and the only
+    readback is the packed [epoch, criteria] pair."""
+    num_batches = y_b.shape[0]
+    dtype = _feature_dtype(X_b)
+    _, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+
+    def cond(state):
+        c, crit = state
+        return jnp.logical_and(c[3] < chunk_end, crit > tol)
+
+    def step(state):
+        c, _ = state
+        k = jnp.mod(c[3], num_batches)
+        Xk = _index_batch(X_b, k)
+        yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
+        wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
+        return _epoch_step(Xk, yk, wk, c, loss_func, lr, reg, elastic_net)
+
+    carry, criteria = lax.while_loop(cond, step, (carry, criteria))
+    packed = jnp.stack([carry[3].astype(jnp.float32), criteria])
+    return carry, criteria, packed
+
+
+_sgd_chunk = jax.jit(_sgd_chunk_impl, static_argnames=("loss_func",))
+_sgd_chunk_donating = jax.jit(
+    _sgd_chunk_impl, static_argnames=("loss_func",), donate_argnums=(3, 4)
+)
 
 
 def unpack_train_result(host: np.ndarray, d: int, has_flag: bool = False):
-    """Host-side inverse of `_pack_result`: returns
+    """Host-side inverse of `_pack_train_result`: returns
     (flag_or_None, coeff[:d], criteria, epochs)."""
     flag = float(host[0]) if has_flag else None
     off = 1 if has_flag else 0
     return flag, host[off : off + d], float(host[-2]), int(host[-1])
 
 
-def read_train_result(async_result, flag=None):
-    """Materialize an `optimize_async` result on the host in one transfer,
-    optionally fusing an extra device scalar (e.g. a label-validity flag)
-    into the same readback. Returns (flag_or_None, coeff[:d], criteria,
-    epochs); the checkpointed host-driven path passes through unchanged."""
-    coeff, criteria, epochs, d = async_result
-    if not isinstance(coeff, jax.Array):  # checkpointed host-driven path
-        return (None if flag is None else float(flag)), coeff[:d], criteria, epochs
+def read_train_result(async_result):
+    """Materialize an `optimize_async` result on the host in one transfer.
+    Returns (flag_or_None, coeff[:d], criteria, epochs); the checkpointed
+    host-driven path passes its host values through unchanged."""
+    import time
+
+    from ..obs import tracing
+
+    if async_result[0] == "host":  # checkpointed host-driven path
+        _, coeff, criteria, epochs, flag, d = async_result
+        return flag, np.asarray(coeff)[:d], criteria, epochs
+    _, packed, d, has_flag = async_result
     # explicit device_get: the transfer-guard readback-budget tests run
     # fits under jax.transfer_guard("disallow") to catch stray implicit pulls
-    host = np.asarray(jax.device_get(_pack_result(coeff, criteria, epochs, flag=flag)))
-    return unpack_train_result(host, d, has_flag=flag is not None)
-
-
-@partial(jax.jit, static_argnames=("loss_func",))
-def _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, elastic_net):
-    """One host-driven epoch over resident batched data — used when
-    checkpointing needs epoch-boundary control on the host."""
-    k = jnp.mod(carry[3], y_b.shape[0])
-    Xk = _index_batch(X_b, k)
-    yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
-    wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
-    return _epoch_step(Xk, yk, wk, carry, loss_func, lr, reg, elastic_net)
+    t0 = time.perf_counter()
+    host = np.asarray(jax.device_get(packed))
+    tracing.account_host_sync("fit")
+    tracing.account_readback(host.nbytes, time.perf_counter() - t0)
+    return unpack_train_result(host, d, has_flag=has_flag)
 
 
 @dataclass
@@ -334,6 +419,24 @@ class SGD:
     The X@coeff contraction then all-reduces over `model` while the
     gradient contraction all-reduces over `data`; both ride ICI."""
 
+    def _hyper(self) -> np.ndarray:
+        """The packed f32 hyper-parameter vector every kernel consumes —
+        ONE host→device upload per dispatch instead of five scalars (see
+        `_unpack_hyper`). max_iter stays f32-exact below 2^24 epochs."""
+        return np.asarray(
+            [self.max_iter, self.tol, self.learning_rate, self.reg, self.elastic_net],
+            np.float32,
+        )
+
+    @staticmethod
+    def _pack_sharding(mesh: Mesh):
+        """Replicated pack layout for multi-axis meshes (see
+        `_pack_train_result` on the GSPMD concatenate partial-sum bug);
+        single-axis meshes need no constraint."""
+        if len(mesh.axis_names) > 1:
+            return NamedSharding(mesh, P())
+        return None
+
     def optimize(
         self,
         init_coeff: np.ndarray,
@@ -356,16 +459,19 @@ class SGD:
         weights: Optional[np.ndarray],
         loss_func: LossFunc,
         mesh: Optional[Mesh] = None,
+        validate_labels: bool = False,
     ):
         """Dispatch the full training program WITHOUT reading results back.
 
-        Returns (coeff, criteria, epochs, true_dim): device arrays on the
-        non-checkpoint path (coeff may be feature-padded — slice [:true_dim]
-        after readback). Callers should pack everything they need into one
-        array (`_pack_result`) and read it back in a single transfer; on
-        remote-attached TPUs every separate readback is a ~100ms round trip.
-        The checkpointed path is host-driven per epoch and returns host
-        values directly."""
+        Returns an opaque async handle for `read_train_result`: on the
+        fused paths a ("packed", device_vector, true_dim, has_flag) tuple
+        whose single device array carries [flag?, coeff, criteria, epochs]
+        (ONE readback materializes everything; on remote-attached TPUs
+        every separate readback is a ~100ms round trip). With
+        `validate_labels` the {0,1} binomial-label check is computed inside
+        the training program and rides the same transfer. The checkpointed
+        path is host-driven in epoch chunks and returns host values
+        directly as ("host", coeff, criteria, epochs, flag, true_dim)."""
         mesh = mesh or mesh_lib.default_mesh()
         # the model length is the feature dim — X may be sparse (indices,
         # values), whose second axis is the nnz width, not the dim
@@ -375,7 +481,10 @@ class SGD:
             and self.checkpoint_dir is None
             and mesh_lib.num_data_shards(mesh) == 1
         ):
-            return self._optimize_flat_async(mesh, init_coeff, X, y, weights, loss_func, d)
+            packed = self._optimize_flat_async(
+                mesh, init_coeff, X, y, weights, loss_func, validate_labels
+            )
+            return ("packed", packed, d, validate_labels)
         if self.shard_features:
             # zero-pad the feature dim to divide over the model axis; padded
             # coefficients start 0, get zero gradients, and stay 0
@@ -393,20 +502,21 @@ class SGD:
             coeff, criteria, epochs = self._optimize_with_checkpoints(
                 X_b, y_b, w_b, init, loss_func
             )
-            return coeff, criteria, epochs, d
-        coeff, criteria, epochs = _sgd_train(
+            flag = None
+            if validate_labels:
+                flag = float(jax.device_get(_binomial_labels_ok(y_b)))
+            return ("host", coeff, criteria, epochs, flag, d)
+        packed = _sgd_train(
             X_b,
             y_b,
             w_b,
             jnp.asarray(init, self.dtype),
             loss_func,
-            jnp.asarray(self.max_iter, jnp.int32),
-            jnp.asarray(self.tol, jnp.float32),
-            jnp.asarray(self.learning_rate, self.dtype),
-            jnp.asarray(self.reg, self.dtype),
-            jnp.asarray(self.elastic_net, self.dtype),
+            self._hyper(),
+            validate_labels,
+            self._pack_sharding(mesh),
         )
-        return coeff, criteria, epochs, d
+        return ("packed", packed, d, validate_labels)
 
     def optimize_stream(
         self,
@@ -505,9 +615,7 @@ class SGD:
 
         row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         mat_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
-        lr = jnp.asarray(self.learning_rate, self.dtype)
-        reg = jnp.asarray(self.reg, self.dtype)
-        en = jnp.asarray(self.elastic_net, self.dtype)
+        hyper = self._hyper()
         carry = (
             jnp.asarray(init_coeff, self.dtype),
             jnp.zeros((d,), self.dtype),
@@ -523,17 +631,25 @@ class SGD:
             )
             if restored is not None:
                 carry, epoch, criteria = restored
+                carry = tuple(jnp.asarray(leaf) for leaf in carry)
         nb = len(segs)
         last_k, batch_dev = None, None
 
         # Double-buffered prefetch: a single worker thread owns every cache
         # read + device_put (native cache access stays serial), staging batch
-        # (epoch+1) % nb while the device runs the current epoch. The host is
-        # blocked in float(crit) during compute, so for cache-resident data
-        # the next batch's H2D rides entirely under the epoch's device time —
-        # the overlap the reference gets from DataCacheReader on Flink's
-        # async mailbox. nb == 1 keeps the single upfront upload.
+        # (epoch+1) % nb while the device runs the current epoch — the
+        # overlap the reference gets from DataCacheReader on Flink's async
+        # mailbox. nb == 1 keeps the single upfront upload. On top of that,
+        # the convergence scalar is drained through a bounded-depth queue
+        # instead of a per-epoch float() sync: dispatched epochs past the
+        # tol-fire point are criteria-guarded identity programs, so the
+        # stop epoch and coefficients are exact (see _stream_epoch_impl).
         from concurrent.futures import ThreadPoolExecutor
+
+        from .. import config
+        from ..obs import tracing
+        from ..parallel import dispatch
+        from ..utils.packing import packed_device_get
 
         def fetch(k):
             sX, sy, sw = segs[k]
@@ -543,34 +659,76 @@ class SGD:
                 jax.device_put(cache.read_array(sw), row_sharding),
             )
 
-        from ..obs import tracing
+        interval = max(1, int(self.checkpoint_interval))
+        donate_ok = dispatch.supports_donation()
+        queue = dispatch.DrainQueue(config.iteration_dispatch_depth)
+        crit_dev = jnp.asarray(criteria, jnp.float32)
+        final_epoch, final_crit = epoch, criteria
+        stopped = criteria <= self.tol
 
-        executor = ThreadPoolExecutor(max_workers=1)
-        fut = executor.submit(fetch, epoch % nb)
-        try:
-            while epoch < self.max_iter and criteria > self.tol:
-                with tracing.span("iteration.epoch", epoch=epoch, mode="stream"):
-                    k = epoch % nb
-                    if k != last_k:  # nb == 1 reads/uploads the batch only once
-                        batch_dev = fut.result()
-                        last_k = k
-                        if nb > 1:
-                            fut = executor.submit(fetch, (epoch + 1) % nb)
-                    carry, crit = _stream_epoch(*batch_dev, carry, loss_func, lr, reg, en)
-                    criteria = float(crit)
-                epoch += 1
+        def handle(drained):
+            nonlocal final_epoch, final_crit, stopped
+            for entry, e_act, crit in drained:
+                advanced = e_act > final_epoch
+                final_epoch, final_crit = e_act, crit
                 if (
-                    self.checkpoint_dir is not None
-                    and epoch % self.checkpoint_interval == 0
+                    advanced
+                    and self.checkpoint_dir is not None
+                    and e_act == entry.end
+                    and e_act % interval == 0
                 ):
                     from ..parallel.iteration import save_iteration_checkpoint
 
                     save_iteration_checkpoint(
-                        self.checkpoint_dir, carry, epoch, criteria,
+                        self.checkpoint_dir, entry.carry, e_act, crit,
                         self.checkpoint_key,
                     )
+                if crit <= self.tol:
+                    stopped = True
+
+        executor = ThreadPoolExecutor(max_workers=1)
+        fut = executor.submit(fetch, epoch % nb)
+        try:
+            planned = epoch
+            donate_next = False
+            while planned < self.max_iter and not stopped:
+                with tracing.span("iteration.epoch", epoch=planned, mode="stream"):
+                    k = planned % nb
+                    if k != last_k:  # nb == 1 reads/uploads the batch only once
+                        batch_dev = fut.result()
+                        last_k = k
+                        if nb > 1:
+                            fut = executor.submit(fetch, (planned + 1) % nb)
+                    retain = (
+                        self.checkpoint_dir is not None
+                        and (planned + 1) % interval == 0
+                    )
+                    step = (
+                        _stream_epoch_donating
+                        if (donate_next and donate_ok)
+                        else _stream_epoch
+                    )
+                    carry, crit_dev, packed = step(
+                        *batch_dev, carry, crit_dev, loss_func, hyper
+                    )
+                handle(
+                    queue.push(
+                        dispatch.InFlight(
+                            planned, planned + 1, carry if retain else None, packed
+                        )
+                    )
+                )
+                planned += 1
+                donate_next = not retain
+            handle(queue.drain_all())
             coeff, grad, wsum, _ = carry
-            coeff = _update_model(coeff, grad, wsum, lr, reg, en)
+            coeff = _update_model(
+                coeff, grad, wsum,
+                jnp.asarray(self.learning_rate, self.dtype),
+                jnp.asarray(self.reg, self.dtype),
+                jnp.asarray(self.elastic_net, self.dtype),
+            )
+            (coeff_h,) = packed_device_get(coeff, sync_kind="fit")
             stats = {
                 "numSegments": cache.num_segments,
                 "spilledSegments": cache.spilled_segments,
@@ -579,15 +737,15 @@ class SGD:
         finally:
             executor.shutdown(wait=True, cancel_futures=True)
             cache.close()
-        return np.asarray(coeff), criteria, epoch, stats
+        return np.asarray(coeff_h), final_crit, final_epoch, stats
 
-    def _optimize_flat_async(self, mesh, init_coeff, X, y, weights, loss_func, d):
+    def _optimize_flat_async(self, mesh, init_coeff, X, y, weights, loss_func, validate_labels):
         """Single-data-shard dispatch: no batched re-layout, no weights
         synthesis program — see `_sgd_train_flat`. Ragged row counts are
         padded to a batch multiple (the only case that copies). Host inputs
         are placed on the mesh's device (a 1-device mesh may deliberately
         pin a fit to a non-default chip); already-device-resident inputs
-        stay where they are."""
+        stay where they are. Returns the packed result device vector."""
         n = int(np.shape(X[0] if isinstance(X, tuple) else X)[0])
         B = int(self.global_batch_size)
         num_batches = max(1, -(-n // B))
@@ -628,7 +786,7 @@ class SGD:
         has_weights = w_f is not None
         if not has_weights:
             w_f = jnp.zeros((0,), self.dtype)
-        coeff, criteria, epochs = _sgd_train_flat(
+        return _sgd_train_flat(
             X_f,
             y_f,
             w_f,
@@ -637,50 +795,110 @@ class SGD:
             B,
             has_weights,
             jnp.asarray(n, jnp.int32),
-            jnp.asarray(self.max_iter, jnp.int32),
-            jnp.asarray(self.tol, jnp.float32),
-            jnp.asarray(self.learning_rate, self.dtype),
-            jnp.asarray(self.reg, self.dtype),
-            jnp.asarray(self.elastic_net, self.dtype),
+            self._hyper(),
+            validate_labels,
         )
-        return coeff, criteria, epochs, d
 
     def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func):
+        """Checkpointed training as a pipeline of epoch CHUNKS: K epochs
+        per device program (chunk ends clamp to checkpoint boundaries so
+        the snapshot cadence is exact), one packed (epoch, criteria)
+        readback per chunk, and up to `config.iteration_dispatch_depth`
+        chunks in flight before the oldest is drained. The per-epoch tol
+        check runs inside each chunk's while condition, so the stop epoch
+        and coefficients match the old one-epoch-per-dispatch loop exactly;
+        chunks dispatched past the tol-fire epoch are identity programs.
+        Carries of non-boundary chunks are donated (HBM ping-pong)."""
+        from .. import config
+        from ..obs import tracing
+        from ..parallel import dispatch
         from ..parallel.iteration import (
             load_iteration_checkpoint,
             save_iteration_checkpoint,
         )
+        from ..utils.packing import packed_device_get
 
         d = init_coeff.shape[0]  # X_b may be the sparse (indices, values) tuple
-        lr = jnp.asarray(self.learning_rate, self.dtype)
-        reg = jnp.asarray(self.reg, self.dtype)
-        en = jnp.asarray(self.elastic_net, self.dtype)
+        hyper = self._hyper()
         carry = (
             jnp.asarray(init_coeff, self.dtype),
             jnp.zeros((d,), self.dtype),
             jnp.asarray(0.0, self.dtype),
             jnp.asarray(0, jnp.int32),
         )
-        from ..obs import tracing
-
         epoch, criteria = 0, float("inf")
         restored = load_iteration_checkpoint(
             self.checkpoint_dir, carry, self.checkpoint_key
         )
         if restored is not None:
             carry, epoch, criteria = restored
-        while epoch < self.max_iter and criteria > self.tol:
-            with tracing.span("iteration.epoch", epoch=epoch, mode="checkpointed"):
-                carry, crit = _sgd_epoch(X_b, y_b, w_b, carry, loss_func, lr, reg, en)
-                criteria = float(crit)
-            epoch += 1
-            if epoch % self.checkpoint_interval == 0:
-                save_iteration_checkpoint(
-                    self.checkpoint_dir, carry, epoch, criteria, self.checkpoint_key
+            carry = tuple(jnp.asarray(leaf) for leaf in carry)
+            # the restored epoch counter must live in the carry (the chunk
+            # kernel's loop condition reads carry[3])
+            carry = carry[:3] + (jnp.asarray(epoch, jnp.int32),)
+
+        interval = max(1, int(self.checkpoint_interval))
+        K = config.iteration_chunk_for(self.max_iter)
+        donate_ok = dispatch.supports_donation()
+        queue = dispatch.DrainQueue(config.iteration_dispatch_depth)
+        crit_dev = jnp.asarray(criteria, jnp.float32)
+        final_epoch, final_crit = epoch, criteria
+        stopped = criteria <= self.tol
+
+        def handle(drained):
+            nonlocal final_epoch, final_crit, stopped
+            for entry, e_act, crit in drained:
+                advanced = e_act > final_epoch
+                final_epoch, final_crit = e_act, crit
+                if advanced and e_act == entry.end and e_act % interval == 0:
+                    save_iteration_checkpoint(
+                        self.checkpoint_dir, entry.carry, e_act, crit,
+                        self.checkpoint_key,
+                    )
+                if crit <= self.tol:
+                    stopped = True
+
+        with tracing.span(
+            "iteration.run", mode="chunked", chunk=K, depth=queue.depth
+        ):
+            planned = epoch
+            donate_next = False
+            while planned < self.max_iter and not stopped:
+                end = min(
+                    planned + K,
+                    self.max_iter,
+                    dispatch.next_boundary(planned, interval),
                 )
+                retain = end % interval == 0
+                step = (
+                    _sgd_chunk_donating if (donate_next and donate_ok) else _sgd_chunk
+                )
+                with tracing.span("iteration.chunk", epoch=planned, end=end):
+                    carry, crit_dev, packed = step(
+                        X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
+                        jnp.asarray(end, jnp.int32),
+                    )
+                handle(
+                    queue.push(
+                        dispatch.InFlight(
+                            planned, end, carry if retain else None, packed
+                        )
+                    )
+                )
+                planned = end
+                donate_next = not retain
+            handle(queue.drain_all())
+
         coeff, grad, wsum, _ = carry
-        coeff = _update_model(coeff, grad, wsum, lr, reg, en)
-        return np.asarray(coeff), criteria, epoch
+        dtype = _feature_dtype(X_b)
+        coeff = _update_model(
+            coeff, grad, wsum,
+            jnp.asarray(self.learning_rate, dtype),
+            jnp.asarray(self.reg, dtype),
+            jnp.asarray(self.elastic_net, dtype),
+        )
+        (coeff_h,) = packed_device_get(coeff, sync_kind="fit")
+        return np.asarray(coeff_h), final_crit, final_epoch
 
     def _batchify(self, mesh: Mesh, X, y, weights, d_pad=None):
         """Stage data into device-resident (num_batches, padded_batch, ...)

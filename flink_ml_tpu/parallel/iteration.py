@@ -93,7 +93,13 @@ def _checkpoint_file(path: str, job_key: Optional[str]) -> str:
 def save_iteration_checkpoint(
     path: str, carry, epoch: int, criteria: float, job_key: Optional[str] = None
 ) -> None:
+    from ..utils.packing import packed_device_get
+
     leaves = jax.tree_util.tree_leaves(carry)
+    # one packed D2H transfer for the whole carry (a per-leaf np.asarray
+    # pull costs one tunnel round trip PER LEAF); counted as a checkpoint
+    # host sync so BENCH deltas separate snapshot cost from drain cost
+    leaves = packed_device_get(*leaves, sync_kind="checkpoint")
     os.makedirs(path, exist_ok=True)
     target = _checkpoint_file(path, job_key)
     tmp = target[: -len(".npz")] + ".tmp.npz"  # keep .npz so savez won't rename
@@ -153,20 +159,33 @@ def iterate_bounded(
     listener: Optional[IterationListener] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_interval: int = 1,
+    chunk_size: Optional[int] = None,
 ) -> IterationResult:
     """Run `body(carry, epoch) -> (carry, criteria)` until termination.
 
     Termination mirrors TerminateOnMaxIterOrTol.java:72: stop when
     `epoch >= max_iter` or (if `tol` is set) `criteria <= tol`. With no
     listener and no checkpointing the whole loop compiles to one XLA
-    while-loop (the feedback edge never leaves the device). With a listener
-    or checkpointing, each epoch is one jitted device step driven from the
-    host — the analogue of ALL_ROUND operators observing epoch watermarks.
+    while-loop (the feedback edge never leaves the device). With a
+    listener, each epoch is one jitted device step (the analogue of
+    ALL_ROUND operators observing epoch watermarks); with checkpointing
+    only, epochs run in K-sized chunks (`chunk_size`, default from
+    config.iteration_chunk_for) with one packed convergence readback per
+    chunk — the stop epoch and final carry are identical to the per-epoch
+    loop for any K because the tol check still runs every epoch inside
+    the chunk program (see docs/performance.md).
     """
     if listener is None and checkpoint_dir is None:
         return _iterate_on_device(body, init_carry, max_iter, tol)
     return _iterate_host_driven(
-        body, init_carry, max_iter, tol, listener, checkpoint_dir, checkpoint_interval
+        body,
+        init_carry,
+        max_iter,
+        tol,
+        listener,
+        checkpoint_dir,
+        checkpoint_interval,
+        chunk_size,
     )
 
 
@@ -202,37 +221,116 @@ def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[fl
 
 
 def _iterate_host_driven(
-    body, init_carry, max_iter, tol, listener, checkpoint_dir, checkpoint_interval
+    body,
+    init_carry,
+    max_iter,
+    tol,
+    listener,
+    checkpoint_dir,
+    checkpoint_interval,
+    chunk_size=None,
 ):
-    jitted = jax.jit(body)
-    carry, epoch, criteria = init_carry, 0, float("inf")
+    """Pipelined host-driven loop.
 
+    With a listener, each epoch is one dispatched program (the listener
+    contract exposes every (epoch, carry) pair); with checkpointing only,
+    K epochs fuse into one chunk program whose ends clamp to checkpoint
+    boundaries. Either way, dispatched steps queue up to
+    `config.iteration_dispatch_depth` deep before their packed
+    (epoch, criteria) scalars are drained, so host Python overlaps device
+    execution instead of serializing on every convergence readback.
+
+    Exactness under speculation: every dispatched step is criteria-guarded
+    on device (the chunk's while condition re-checks `criteria > tol`
+    before each epoch), so steps dispatched past the tol-fire epoch are
+    identity programs — the final carry, stop epoch, and stop criteria
+    are bit-identical to the fully synchronous per-epoch loop.
+    """
+    from .. import config
+    from ..utils import metrics
+    from . import dispatch
+
+    carry, epoch, criteria = init_carry, 0, float("inf")
     if checkpoint_dir is not None:
         restored = load_iteration_checkpoint(checkpoint_dir, init_carry)
         if restored is not None:
             carry, epoch, criteria = restored
 
-    from ..utils import metrics
+    per_epoch = listener is not None
+    K = 1 if per_epoch else config.iteration_chunk_for(max_iter, chunk_size)
+    runner = dispatch.chunk_runner(body)
+    donate_ok = dispatch.supports_donation()
+    tol_value = jnp.asarray(-jnp.inf if tol is None else float(tol), jnp.float32)
 
-    with tracing.span("iteration.run", mode="host") as run_sp:
-        while epoch < max_iter and (tol is None or criteria > tol):
-            with tracing.span("iteration.epoch", epoch=epoch) as ep_sp:
-                with metrics.timed("iteration.epoch"):
-                    carry, criteria_arr = jitted(carry, jnp.asarray(epoch, jnp.int32))
-                    criteria = float(criteria_arr)
-                ep_sp.set_attr("criteria", criteria)
-            epoch += 1
-            metrics.set_gauge("iteration.epochs", epoch)
-            if listener is not None:
-                listener.on_epoch_watermark_incremented(epoch, carry)
-            if checkpoint_dir is not None and epoch % checkpoint_interval == 0:
-                save_iteration_checkpoint(checkpoint_dir, carry, epoch, criteria)
-        run_sp.set_attr("epochs", epoch)
-        run_sp.set_attr("finalCriteria", criteria)
+    epoch_dev = jnp.asarray(epoch, jnp.int32)
+    crit_dev = jnp.asarray(criteria, jnp.float32)
+    queue = dispatch.DrainQueue(config.iteration_dispatch_depth)
+    final_epoch, final_crit = epoch, criteria
+    stopped = tol is not None and criteria <= tol
+
+    def handle(drained):
+        nonlocal final_epoch, final_crit, stopped
+        for entry, e_act, crit in drained:
+            advanced = e_act > final_epoch
+            final_epoch, final_crit = e_act, crit
+            metrics.set_gauge("iteration.epochs", final_epoch)
+            if not advanced:
+                continue  # speculative identity step past the stop epoch
+            if per_epoch:
+                listener.on_epoch_watermark_incremented(e_act, entry.carry)
+            if (
+                checkpoint_dir is not None
+                and e_act == entry.end
+                and e_act % checkpoint_interval == 0
+            ):
+                save_iteration_checkpoint(checkpoint_dir, entry.carry, e_act, crit)
+            if tol is not None and crit <= tol:
+                stopped = True
+
+    mode = "host" if per_epoch else "chunked"
+    with tracing.span(
+        "iteration.run", mode=mode, chunk=K, depth=queue.depth
+    ) as run_sp:
+        planned = epoch
+        donate_next = False  # never consume the caller's init carry
+        while planned < max_iter and not stopped:
+            end = min(planned + K, max_iter)
+            boundary = dispatch.next_boundary(
+                planned, checkpoint_interval if checkpoint_dir is not None else None
+            )
+            if boundary is not None:
+                end = min(end, boundary)
+            # retain the post-chunk carry when the drain handler will need
+            # it on host (listener callback / checkpoint snapshot) — a
+            # retained carry must not be donated into the next dispatch
+            retain = per_epoch or (
+                checkpoint_dir is not None and end % checkpoint_interval == 0
+            )
+            step = runner.donating if (donate_next and donate_ok) else runner.borrowing
+            with tracing.span(
+                "iteration.epoch" if per_epoch else "iteration.chunk",
+                epoch=planned,
+                **({} if per_epoch else {"end": end}),
+            ):
+                with metrics.timed("iteration.epoch" if per_epoch else "iteration.chunk"):
+                    carry, epoch_dev, crit_dev, packed = step(
+                        carry, epoch_dev, crit_dev,
+                        jnp.asarray(end, jnp.int32), tol_value,
+                    )
+            handle(
+                queue.push(
+                    dispatch.InFlight(planned, end, carry if retain else None, packed)
+                )
+            )
+            planned = end
+            donate_next = not retain
+        handle(queue.drain_all())
+        run_sp.set_attr("epochs", final_epoch)
+        run_sp.set_attr("finalCriteria", final_crit)
 
     if listener is not None:
         listener.on_iteration_terminated(carry)
-    return IterationResult(carry, epoch, criteria)
+    return IterationResult(carry, final_epoch, final_crit)
 
 
 def scan_epochs(body: BodyFn, init_carry, num_epochs: int):

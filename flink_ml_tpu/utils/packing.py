@@ -23,12 +23,15 @@ from typing import List
 import numpy as np
 
 
-def packed_device_get(*arrays) -> List[np.ndarray]:
+def packed_device_get(*arrays, sync_kind: str = "readback") -> List[np.ndarray]:
     """Return host copies of ``arrays`` via at most one D2H transfer.
 
     Host inputs pass through as-is (never uploaded just to be pulled
     back); device inputs are flattened into one concatenated transfer and
-    restored to their original shapes AND dtypes on the host."""
+    restored to their original shapes AND dtypes on the host. A call with
+    any device input is one blocking host↔device synchronization point and
+    is accounted as ``iteration.host_sync.<sync_kind>`` — callers on named
+    paths (fit results, checkpoint snapshots) pass their kind."""
     import jax
     import jax.numpy as jnp
 
@@ -43,6 +46,7 @@ def packed_device_get(*arrays) -> List[np.ndarray]:
             out[i] = np.asarray(a)
     if not device_idx:
         return out
+    tracing.account_host_sync(sync_kind)
     if len(device_idx) == 1:
         i = device_idx[0]
         t0 = time.perf_counter()

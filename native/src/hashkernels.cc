@@ -14,10 +14,14 @@
 // Build: compiled together with datacache.cc into the runtime .so
 // (flink_ml_tpu/native/__init__.py).
 
-#include <charconv>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#if defined(__cpp_lib_to_chars)
+#include <charconv>
+#endif
 
 namespace {
 
@@ -90,8 +94,33 @@ inline long render_java_double(double v, uint16_t* out) {
     out[n++] = '0'; out[n++] = '.'; out[n++] = '0';
     return n;
   }
-  char buf[40];
-  auto res = std::to_chars(buf, buf + sizeof(buf), a, std::chars_format::scientific);
+  char buf[48];
+  const char* end;
+#if defined(__cpp_lib_to_chars)
+  {
+    auto res = std::to_chars(buf, buf + sizeof(buf), a, std::chars_format::scientific);
+    end = res.ptr;
+  }
+#else
+  // GCC 10's libstdc++ ships no floating-point to_chars: find the shortest
+  // round-trip digit count by probing snprintf %.*e at rising precision.
+  // Correct rounding makes the first round-tripping precision produce the
+  // same digits to_chars' shortest form would (the correctly-rounded
+  // L-digit string is the closest one; if it doesn't round-trip, no other
+  // L-digit string can).
+  {
+    int prec = 17;
+    for (int p = 1; p <= 17; ++p) {
+      std::snprintf(buf, sizeof(buf), "%.*e", p - 1, a);
+      if (std::strtod(buf, nullptr) == a) {
+        prec = p;
+        break;
+      }
+    }
+    (void)prec;
+    end = buf + std::strlen(buf);
+  }
+#endif
   // parse "d[.ddd]e±xx" into digit string + decimal exponent
   char digits[24];
   int nd = 0;
@@ -99,15 +128,15 @@ inline long render_java_double(double v, uint16_t* out) {
   {
     const char* p = buf;
     digits[nd++] = *p++;
-    if (*p == '.') {
+    if (*p == '.' || *p == ',') {  // tolerate locale decimal separators
       ++p;
-      while (p < res.ptr && *p != 'e') digits[nd++] = *p++;
+      while (p < end && *p != 'e' && *p != 'E') digits[nd++] = *p++;
     }
     // *p == 'e'
     ++p;
     bool neg = (*p == '-');
     if (*p == '+' || *p == '-') ++p;
-    while (p < res.ptr) exp10 = exp10 * 10 + (*p++ - '0');
+    while (p < end && *p >= '0' && *p <= '9') exp10 = exp10 * 10 + (*p++ - '0');
     if (neg) exp10 = -exp10;
   }
   if (exp10 >= -3 && exp10 <= 6) {  // decimal form

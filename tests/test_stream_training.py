@@ -134,8 +134,8 @@ class TestStreamSGD:
             time.sleep(0.03)
             return real_read(self, seg)
 
-        def slow_epoch(Xk, yk, wk, carry, loss_func, lr, reg, en):
-            out = real_epoch(Xk, yk, wk, carry, loss_func, lr, reg, en)
+        def slow_epoch(*args, **kwargs):
+            out = real_epoch(*args, **kwargs)
             jax.block_until_ready(out[1])
             time.sleep(0.10)
             return out
