@@ -10,10 +10,38 @@ Estimator.java:30) with Tables replaced by the columnar Table of
 from __future__ import annotations
 
 import abc
-from typing import List
+from typing import Any, Dict, List
 
 from .param import WithParams
 from .table import Table
+
+
+class KernelContext:
+    """Trace-time collector of deferred validation guards.
+
+    A fused transform kernel cannot raise on data-dependent conditions (a
+    Python `if` on a traced value would force a host sync mid-program), so
+    kernels register a scalar predicate + message here instead. The fusion
+    runner returns the guards as extra program outputs and reads them back
+    in ONE packed transfer at the pipeline exit / host-segment boundary,
+    raising the registered message when a predicate fired.
+    """
+
+    def __init__(self):
+        self.guards: Dict[str, Any] = {}
+
+    def guard(self, pred, message: str) -> None:
+        """Register `pred` (scalar bool array, True == invalid) to raise
+        ValueError(message) at the next guard drain."""
+        prev = self.guards.get(message)
+        self.guards[message] = pred if prev is None else prev | pred
+
+
+def as_kernel_matrix(col):
+    """`as_dense_matrix`'s device-passthrough shape rule for kernel code:
+    a 1-D column becomes an (n, 1) matrix, everything else passes through.
+    Works on tracers — kernels must not touch numpy conversion paths."""
+    return col if col.ndim > 1 else col[:, None]
 
 
 class Stage(WithParams, abc.ABC):
@@ -58,11 +86,118 @@ class Stage(WithParams, abc.ABC):
 
 
 class AlgoOperator(Stage):
-    """A stage that transforms N input tables into M output tables (AlgoOperator.java:31)."""
+    """A stage that transforms N input tables into M output tables (AlgoOperator.java:31).
+
+    Transform-kernel protocol (pipeline fusion): a stage whose transform is
+    a pure per-batch device computation may set `fusable = True` and expose
+
+    - `transform_kernel(consts, cols, ctx)` — a jit-traceable function from
+      a column dict to a column dict. `consts` is the pytree returned by
+      `device_constants()`; `cols` maps column names to device arrays (or
+      SparseBatch); data-dependent validation goes through `ctx.guard`.
+      Parameters may be read from `self` — they are trace-time constants
+      (param changes invalidate the compiled plan via the params version).
+    - `_kernel_constants()` — host-side model constants (arrays/scalars)
+      uploaded once per model instance and cached by `device_constants()`.
+    - `_constant_sources()` — the raw arrays whose identity keys the cache.
+
+    The fusion planner (pipeline.py) composes consecutive fusable stages'
+    kernels into ONE device program. Stages whose transform is inherently
+    host-resident (string rendering, dynamic row counts, host-precision
+    contracts) must set `fusable = False` with a non-empty `fusable_reason`
+    — scripts/check_fusion_coverage.py enforces that every concrete stage
+    states one or the other.
+    """
+
+    # fusion contract: True requires transform_kernel; False requires a reason
+    fusable: bool = False
+    fusable_reason: str = ""
+    # column kinds this stage's kernel handles beyond dense arrays
+    kernel_supports_sparse: bool = False
+    # True when kernel_output_cols are SparseBatch (downstream gating)
+    kernel_emits_sparse: bool = False
 
     @abc.abstractmethod
     def transform(self, *inputs: Table) -> List[Table]:
         ...
+
+    def supports_fusion(self) -> bool:
+        """Param-level fusion gate — override when some param settings make
+        the transform impure (e.g. handleInvalid='skip' drops rows)."""
+        return self.fusable
+
+    def transform_kernel(self, consts, cols: Dict[str, Any], ctx: KernelContext) -> Dict[str, Any]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not expose a transform kernel"
+        )
+
+    def kernel_input_cols(self) -> List[str]:
+        """Columns the kernel reads from its input table, derived from the
+        stage's column params; override when the derivation doesn't fit."""
+        cols: List[str] = []
+        for getter in ("get_input_col", "get_features_col"):
+            if hasattr(self, getter):
+                value = getattr(self, getter)()
+                if value:
+                    cols.append(value)
+        if hasattr(self, "get_input_cols"):
+            cols.extend(self.get_input_cols() or ())
+        return cols
+
+    def kernel_output_cols(self) -> List[str]:
+        """Columns the kernel writes, derived from the stage's column params."""
+        cols: List[str] = []
+        for getter in (
+            "get_output_col",
+            "get_prediction_col",
+            "get_raw_prediction_col",
+        ):
+            if hasattr(self, getter):
+                value = getattr(self, getter)()
+                if value:
+                    cols.append(value)
+        if hasattr(self, "get_output_cols"):
+            cols.extend(self.get_output_cols() or ())
+        return cols
+
+    def kernel_ready(self, cols: Dict[str, Any]) -> bool:
+        """Runtime veto hook: `cols` maps this stage's kernel input names to
+        the actual columns (or a dense placeholder for columns produced
+        earlier in the segment). Override for checks the generic kind gating
+        can't express (e.g. Bucketizer's split/dtype round-trip)."""
+        return True
+
+    # -- device-constant memoization ----------------------------------------
+    def _kernel_constants(self) -> Dict[str, Any]:
+        """Host-side constants the kernel needs (model arrays, derived
+        scales). Derived values must be computed here — NOT in the kernel —
+        when the eager path computes them in host precision."""
+        return {}
+
+    def _constant_sources(self) -> tuple:
+        """Raw arrays whose object identity versions the constant cache."""
+        return ()
+
+    def device_constants(self):
+        """Device-resident `_kernel_constants()`, uploaded at most once per
+        (model arrays, params) state. Model arrays are re-assigned (never
+        mutated in place) across this codebase, so object identity of the
+        `_constant_sources()` plus the params version is a sound cache key."""
+        import jax
+
+        token = (
+            self.__dict__.get("_params_version", 0),
+            tuple(id(a) for a in self._constant_sources()),
+        )
+        cached = self.__dict__.get("_device_consts")
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        consts = jax.tree_util.tree_map(jax.device_put, self._kernel_constants())
+        self.__dict__["_device_consts"] = (token, consts)
+        return consts
+
+    def invalidate_device_constants(self) -> None:
+        self.__dict__.pop("_device_consts", None)
 
 
 class Transformer(AlgoOperator):

@@ -150,6 +150,9 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # between BENCH files is a dispatch regression
         "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
         "dispatchDepth": int(delta["gauges"].get("iteration.dispatch_depth", 0)),
+        # segments the transform phase fused (0 = eager per-stage path); a
+        # drop between BENCH files means stages fell off the fused path
+        "fusedSegments": int(delta["gauges"].get("pipeline.fused_segments", 0)),
         "metrics": delta,
     }
 

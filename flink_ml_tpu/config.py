@@ -39,6 +39,37 @@ def iteration_chunk_for(max_iter: int, chunk_size: Optional[int] = None) -> int:
     return max(1, min(int(k), max(1, int(max_iter))))
 
 
+# --- pipeline transform fusion (pipeline.py) ----------------------------------
+# "auto": PipelineModel.transform compiles maximal runs of fusable stages
+# into single device programs when their input columns are device-resident
+# (one dispatch per segment instead of one per stage). "off": always the
+# eager per-stage path — the reference for the fused-vs-eager parity suite.
+pipeline_fusion: str = "auto"
+
+# Max transformed-but-undrained micro-batches the serving runner keeps in
+# flight (serving.MicroBatchServer): batch i+1's H2D upload and compute
+# overlap batch i's pending guard drain instead of serializing on it.
+serving_in_flight: int = 2
+
+
+@contextmanager
+def pipeline_fusion_mode(mode: str):
+    """Scoped override of `pipeline_fusion` ("auto" | "off")."""
+    global pipeline_fusion
+    if mode not in ("auto", "off"):
+        raise ValueError(f"Unknown pipeline_fusion mode {mode!r}")
+    prev = pipeline_fusion
+    pipeline_fusion = mode
+    try:
+        yield
+    finally:
+        pipeline_fusion = prev
+
+
+if os.environ.get("FLINK_ML_TPU_PIPELINE_FUSION") in ("auto", "off"):
+    pipeline_fusion = os.environ["FLINK_ML_TPU_PIPELINE_FUSION"]
+
+
 # --- persistent XLA compilation cache ----------------------------------------
 # Cold-start killer: compiled executables survive process restarts, so the
 # first fit of a new process reuses the previous process's XLA programs

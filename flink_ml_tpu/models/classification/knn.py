@@ -74,6 +74,9 @@ def _majority_vote(neighbor_labels: np.ndarray) -> np.ndarray:
 
 
 class KnnModel(Model, KnnModelParams):
+    fusable = False
+    fusable_reason = "top-k search runs as its own chunked device driver; the k-neighbor label vote is host-side f64"
+
     def __init__(self):
         self.features: np.ndarray = None  # (n_train, d)
         self.labels: np.ndarray = None  # (n_train,)

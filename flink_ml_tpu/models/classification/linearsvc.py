@@ -78,8 +78,29 @@ def _predict(X, coeff, threshold):
 
 
 class LinearSVCModel(Model, LinearSVCModelParams):
+    fusable = True
+    kernel_supports_sparse = True
+
     def __init__(self):
         self.coefficient: np.ndarray = None  # (d,)
+
+    def _constant_sources(self):
+        return (self.coefficient,)
+
+    def _kernel_constants(self):
+        return {
+            "coefficient": np.asarray(self.coefficient, np.float32),
+            "threshold": np.float32(self.get_threshold()),
+        }
+
+    def transform_kernel(self, consts, cols, ctx):
+        from .. import _linear
+
+        dot = _linear.raw_scores(cols[self.get_features_col()], consts["coefficient"])
+        pred, raw = _predict_from_dot(dot, consts["threshold"])
+        cols[self.get_prediction_col()] = pred
+        cols[self.get_raw_prediction_col()] = raw
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "LinearSVCModel":
         (model_data,) = inputs

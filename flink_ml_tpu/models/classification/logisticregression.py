@@ -75,8 +75,24 @@ def _predict(X, coeff):
 
 
 class LogisticRegressionModel(Model, LogisticRegressionModelParams):
+    fusable = True
+    kernel_supports_sparse = True
+
     def __init__(self):
         self.coefficient: np.ndarray = None  # (d,)
+
+    def _constant_sources(self):
+        return (self.coefficient,)
+
+    def _kernel_constants(self):
+        return {"coefficient": np.asarray(self.coefficient, np.float32)}
+
+    def transform_kernel(self, consts, cols, ctx):
+        dot = _linear.raw_scores(cols[self.get_features_col()], consts["coefficient"])
+        pred, raw = _predict_from_dot(dot)
+        cols[self.get_prediction_col()] = pred
+        cols[self.get_raw_prediction_col()] = raw
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "LogisticRegressionModel":
         (model_data,) = inputs
@@ -94,14 +110,20 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
         col = table.column(self.get_features_col())
         from ...table import SparseBatch
 
+        def _coeff(device_in: bool):
+            # memoized device-resident coefficient on the device path
+            if device_in:
+                return self.device_constants()["coefficient"]
+            return jnp.asarray(self.coefficient, jnp.float32)
+
         if isinstance(col, SparseBatch):  # wide sparse: never densify
-            dot = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
-            pred, raw = _predict_from_dot(dot)
             device_in = isinstance(col.indices, jax.Array)
+            dot = _linear.raw_scores(col, _coeff(device_in))
+            pred, raw = _predict_from_dot(dot)
         else:
             X = as_dense_matrix(col, allow_device=True)
             device_in = isinstance(X, jax.Array)
-            pred, raw = _predict(jnp.asarray(X, jnp.float32), jnp.asarray(self.coefficient, jnp.float32))
+            pred, raw = _predict(jnp.asarray(X, jnp.float32), _coeff(device_in))
         if device_in:  # device data in -> device predictions out, no D2H
             cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
         else:

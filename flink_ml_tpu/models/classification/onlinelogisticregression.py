@@ -92,6 +92,9 @@ def _ftrl_step(coeff, z, n, X, y, alpha, beta, l1, l2):
 
 
 class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
+    fusable = False
+    fusable_reason = "streaming model: serves the latest mutable host snapshot and stamps modelDataVersion per call; baking it into a compiled plan would freeze a stale model"
+
     def __init__(self):
         self.coefficient: np.ndarray = None
         self.model_version: int = 0

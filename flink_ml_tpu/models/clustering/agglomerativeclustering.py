@@ -257,6 +257,9 @@ def _cluster_block(X, linkage, measure, num_clusters, threshold, compute_full_tr
 
 
 class AgglomerativeClustering(AlgoOperator, AgglomerativeClusteringParams):
+    fusable = False
+    fusable_reason = "O(n^2) host linkage build (prefers_host_input); no record-wise device kernel exists"
+
     # the linkage matrix is built row-by-row on host (no device kernels at
     # all), so device-born input costs a full D2H pull of the dataset
     # before any work starts — the slowest per-record entry in round 5's

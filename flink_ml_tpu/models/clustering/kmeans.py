@@ -102,10 +102,27 @@ _lloyd_train_donating = jax.jit(
 
 
 class KMeansModel(Model, KMeansModelParams):
+    fusable = True
+
     def __init__(self):
         self.centroids: np.ndarray = None  # (k, d)
         self.weights: np.ndarray = None  # (k,)
         self.cache_stats = None  # set by out-of-core (StreamTable) fits
+
+    def _constant_sources(self):
+        return (self.centroids,)
+
+    def _kernel_constants(self):
+        return {"centroids": np.asarray(self.centroids, np.float32)}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_features_col()])
+        cols[self.get_prediction_col()] = jit_find_closest(
+            self.get_distance_measure()
+        )(jnp.asarray(X, jnp.float32), consts["centroids"])
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "KMeansModel":
         (model_data,) = inputs
@@ -133,8 +150,13 @@ class KMeansModel(Model, KMeansModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
+        centroids = (
+            self.device_constants()["centroids"]  # memoized upload
+            if isinstance(X, jax.Array)
+            else jnp.asarray(self.centroids, jnp.float32)
+        )
         assign = jit_find_closest(self.get_distance_measure())(
-            jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
+            jnp.asarray(X, jnp.float32), centroids
         )
         if not isinstance(X, jax.Array):  # host in -> host out
             assign = np.asarray(assign, dtype=np.int32)

@@ -96,6 +96,8 @@ class OnlineKMeansModel(Model, KMeansModelParams):
     """Serves predictions from the latest model version
     (OnlineKMeansModel.java; `model_version` mirrors the modelDataVersion
     gauge)."""
+    fusable = False
+    fusable_reason = "streaming model: serves the latest mutable centroid snapshot (modelDataVersion semantics); baking it into a compiled plan would freeze a stale model"
 
     def __init__(self):
         self.centroids: np.ndarray = None

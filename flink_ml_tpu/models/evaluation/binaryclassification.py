@@ -185,6 +185,9 @@ def _binary_metrics_device(scores, labels, weights):
 
 
 class BinaryClassificationEvaluator(AlgoOperator, BinaryClassificationEvaluatorParams):
+    fusable = False
+    fusable_reason = "aggregating evaluator: reduces the whole input to one metrics row — not a row-count-preserving record-wise transform"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         labels_col = table.column(self.get_label_col())

@@ -44,6 +44,22 @@ class BinarizerParams(HasInputCols, HasOutputCols):
 
 
 class Binarizer(Transformer, BinarizerParams):
+    fusable = True
+
+    def transform_kernel(self, consts, cols, ctx):
+        import jax.numpy as jnp
+
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        thresholds = self.get_thresholds()
+        if len(in_cols) != len(thresholds):
+            raise ValueError(
+                "Binarizer: number of thresholds must match number of input columns"
+            )
+        for name, out_name, thr in zip(in_cols, out_cols, thresholds):
+            col = cols[name]
+            cols[out_name] = _binarize_impl(col, jnp.asarray(thr, col.dtype))
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         in_cols, out_cols = self.get_input_cols(), self.get_output_cols()

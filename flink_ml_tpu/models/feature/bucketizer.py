@@ -58,6 +58,55 @@ class BucketizerParams(HasInputCols, HasOutputCols, HasHandleInvalid):
 
 
 class Bucketizer(Transformer, BucketizerParams):
+    fusable = True
+
+    def supports_fusion(self) -> bool:
+        # 'skip' drops invalid rows — a data-dependent row count no pure
+        # static-shape kernel can express
+        return self.get_handle_invalid() != HasHandleInvalid.SKIP_INVALID
+
+    def kernel_ready(self, cols) -> bool:
+        # mirror the eager fallback: when a split point has no exact
+        # representation in the column dtype the device compare would move
+        # boundary values into the wrong bucket — host path only
+        splits_array = self.get_splits_array() or []
+        for name, splits in zip(self.get_input_cols() or [], splits_array):
+            col = cols.get(name)
+            if col is None:
+                return False
+            splits = np.asarray(splits, dtype=np.float64)
+            cast = splits.astype(np.dtype(col.dtype))
+            if not np.array_equal(cast.astype(np.float64), splits):
+                return False
+        return True
+
+    def transform_kernel(self, consts, cols, ctx):
+        import jax.numpy as jnp
+
+        in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
+        splits_array = self.get_splits_array()
+        if len(in_cols) != len(splits_array):
+            raise ValueError(
+                "Bucketizer: number of splits arrays must match number of input columns"
+            )
+        handle = self.get_handle_invalid()
+        for name, out_name, splits in zip(in_cols, out_cols, splits_array):
+            col = cols[name]
+            splits = np.asarray(splits, dtype=np.float64)
+            num_buckets = len(splits) - 1
+            idx, bad = _bucketize_impl(col, jnp.asarray(splits, col.dtype))
+            if handle == HasHandleInvalid.KEEP_INVALID:
+                idx = jnp.where(bad, float(num_buckets), idx)
+            else:  # error: deferred to the fused guard drain
+                ctx.guard(
+                    bad.any(),
+                    "The input contains invalid value. See "
+                    + self.HANDLE_INVALID.name
+                    + " parameter for more options.",
+                )
+            cols[out_name] = idx
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         in_cols, out_cols = self.get_input_cols(), self.get_output_cols()
@@ -113,6 +162,9 @@ class Bucketizer(Transformer, BucketizerParams):
                 combined = combined | b
             # scalar probe first: the full mask crosses the tunnel only
             # when a row is actually invalid
+            from ...obs import tracing
+
+            tracing.account_host_sync("transform")
             if bool(combined.any()):
                 invalid_mask |= np.asarray(combined)
         out = table.with_columns(updates)

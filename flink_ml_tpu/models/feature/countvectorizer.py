@@ -89,6 +89,9 @@ class CountVectorizerParams(CountVectorizerModelParams):
 
 
 class CountVectorizerModel(Model, CountVectorizerModelParams):
+    fusable = False
+    fusable_reason = "consumes host token documents; the vocabulary lookup is string-keyed"
+
     def __init__(self):
         self.vocabulary: List[str] = None
 

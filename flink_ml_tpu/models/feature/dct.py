@@ -51,6 +51,22 @@ def _dct_basis(n: int) -> np.ndarray:
 
 
 class DCT(Transformer, DCTParams):
+    fusable = True
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        # the basis depends only on the (static-under-jit) feature dim, so
+        # it folds into the compiled segment as a constant — no per-call
+        # upload, no consts entry
+        B = _dct_basis(X.shape[1])
+        mat = B.T if self.get_inverse() else B
+        cols[self.get_output_col()] = jnp.matmul(
+            jnp.asarray(X, jnp.float32), jnp.asarray(mat.T, jnp.float32)
+        )
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

@@ -33,12 +33,32 @@ class ElementwiseProductParams(HasInputCol, HasOutputCol):
 
 
 class ElementwiseProduct(Transformer, ElementwiseProductParams):
-    def transform(self, *inputs: Table) -> List[Table]:
-        (table,) = inputs
+    fusable = True
+
+    def _scaling_array(self) -> np.ndarray:
         scaling = self.get_scaling_vec()
         if scaling is None:
             raise ValueError("Parameter scalingVec must be set")
-        sv = np.asarray(scaling.to_array(), dtype=np.float64)
+        return np.asarray(scaling.to_array(), dtype=np.float64)
+
+    def _kernel_constants(self):
+        return {"scaling": self._scaling_array()}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        sv = consts["scaling"]
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        if X.shape[1] != sv.shape[0]:
+            raise ValueError(
+                f"Vector size {X.shape[1]} does not match scalingVec size {sv.shape[0]}"
+            )
+        cols[self.get_output_col()] = X * sv[None, :]
+        return cols
+
+    def transform(self, *inputs: Table) -> List[Table]:
+        (table,) = inputs
+        sv = self._scaling_array()
         col = table.column(self.get_input_col())
         if isinstance(col, SparseBatch):
             # Multiply only the stored entries; padded slots (index -1) keep 0.
@@ -50,5 +70,9 @@ class ElementwiseProduct(Transformer, ElementwiseProductParams):
                 raise ValueError(
                     f"Vector size {X.shape[1]} does not match scalingVec size {sv.shape[0]}"
                 )
+            import jax
+
+            if isinstance(X, jax.Array):
+                sv = self.device_constants()["scaling"]  # memoized upload
             out = X * sv[None, :]
         return [table.with_column(self.get_output_col(), out)]

@@ -87,6 +87,9 @@ class FeatureHasherParams(HasInputCols, HasCategoricalCols, HasOutputCol, HasNum
 
 
 class FeatureHasher(Transformer, FeatureHasherParams):
+    fusable = False
+    fusable_reason = "murmur-hashes 'col=value' strings rendered on host (prefers_host_input)"
+
     # categorical hashing renders `col=value` strings — host work by nature
     prefers_host_input = True
 

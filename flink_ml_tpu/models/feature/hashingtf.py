@@ -35,6 +35,9 @@ class HashingTFParams(HasInputCol, HasOutputCol, HasNumFeatures):
 
 
 class HashingTF(Transformer, HashingTFParams):
+    fusable = False
+    fusable_reason = "murmur-hashes host token strings into term frequencies"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         col = table.column(self.get_input_col())

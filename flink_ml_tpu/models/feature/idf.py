@@ -51,10 +51,25 @@ _count_nonzero_per_col = lazy_jit(_count_nonzero_impl)
 
 
 class IDFModel(Model, IDFModelParams):
+    fusable = True
+
     def __init__(self):
         self.idf: np.ndarray = None
         self.doc_freq: np.ndarray = None
         self.num_docs: int = 0
+
+    def _constant_sources(self):
+        return (self.idf,)
+
+    def _kernel_constants(self):
+        return {"idf": self.idf}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        cols[self.get_output_col()] = X * consts["idf"][None, :]
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "IDFModel":
         (model_data,) = inputs
@@ -86,7 +101,15 @@ class IDFModel(Model, IDFModelParams):
             )
             out = SparseBatch(col.size, col.indices.copy(), col.values * gathered)
         else:
-            out = as_dense_matrix(col, allow_device=True) * self.idf[None, :]
+            X = as_dense_matrix(col, allow_device=True)
+            import jax
+
+            idf = (
+                self.device_constants()["idf"]  # memoized upload per instance
+                if isinstance(X, jax.Array)
+                else self.idf
+            )
+            out = X * idf[None, :]
         return [table.with_column(self.get_output_col(), out)]
 
     def _save_extra(self, path: str) -> None:

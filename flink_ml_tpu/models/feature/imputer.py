@@ -126,8 +126,31 @@ class ImputerParams(ImputerModelParams, HasRelativeError):
 
 
 class ImputerModel(Model, ImputerModelParams):
+    fusable = True
+
     def __init__(self):
         self.surrogates: Dict[str, float] = None
+
+    def _constant_sources(self):
+        return (self.surrogates,)
+
+    def _kernel_constants(self):
+        return {
+            "surrogates": [
+                np.asarray(self.surrogates[name]) for name in self.get_input_cols()
+            ]
+        }
+
+    def transform_kernel(self, consts, cols, ctx):
+        missing = float(self.get_missing_value())
+        for i, (name, out_name) in enumerate(
+            zip(self.get_input_cols(), self.get_output_cols())
+        ):
+            col = cols[name]
+            cols[out_name] = _fill_impl(
+                col, consts["surrogates"][i].astype(col.dtype), missing
+            )
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "ImputerModel":
         (model_data,) = inputs

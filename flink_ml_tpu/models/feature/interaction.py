@@ -34,6 +34,18 @@ class InteractionParams(HasInputCols, HasOutputCol):
 
 
 class Interaction(Transformer, InteractionParams):
+    fusable = True
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            raise ValueError("Parameter inputCols must be set")
+        mats = [as_kernel_matrix(cols[name]) for name in in_cols]
+        cols[self.get_output_col()] = _interact_impl(*mats)
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         in_cols = self.get_input_cols()

@@ -89,8 +89,30 @@ def _kmeans_1d_edges(col: np.ndarray, num_bins: int) -> np.ndarray:
 
 
 class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
+    fusable = True
+
     def __init__(self):
         self.bin_edges: List[np.ndarray] = None  # per feature, increasing
+
+    def _constant_sources(self):
+        return (self.bin_edges,)
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        # same padded-edges formulation as the eager device path; the edge
+        # matrix folds into the compiled segment as a constant
+        width = max(e.size for e in self.bin_edges)
+        edges_mat = np.full((len(self.bin_edges), width), np.inf)
+        nbins = np.zeros(len(self.bin_edges), np.int32)
+        for j, e in enumerate(self.bin_edges):
+            edges_mat[j, : e.size] = e
+            nbins[j] = max(e.size - 2, 0)
+        cols[self.get_output_col()] = _bin_all(
+            X, jnp.asarray(edges_mat, X.dtype), jnp.asarray(nbins)
+        )
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "KBinsDiscretizerModel":
         (model_data,) = inputs

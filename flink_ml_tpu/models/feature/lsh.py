@@ -80,6 +80,9 @@ def _jaccard_distance(a_indices: np.ndarray, b_indices: np.ndarray) -> float:
 
 
 class MinHashLSHModel(Model, LSHParams):
+    fusable = False
+    fusable_reason = "emits a per-row list of hash vectors (object column) — not a fixed-shape device array"
+
     def __init__(self):
         self.rand_coefficient_a: np.ndarray = None  # (numHashFunctions,)
         self.rand_coefficient_b: np.ndarray = None

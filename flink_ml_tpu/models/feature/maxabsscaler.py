@@ -28,8 +28,23 @@ class MaxAbsScalerParams(HasInputCol, HasOutputCol):
 
 
 class MaxAbsScalerModel(Model, MaxAbsScalerParams):
+    fusable = True
+
     def __init__(self):
         self.max_abs: np.ndarray = None
+
+    def _constant_sources(self):
+        return (self.max_abs,)
+
+    def _kernel_constants(self):
+        return {"scale": np.where(self.max_abs > 0, self.max_abs, 1.0)}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        cols[self.get_output_col()] = X / consts["scale"][None, :]
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "MaxAbsScalerModel":
         (model_data,) = inputs
@@ -45,7 +60,10 @@ class MaxAbsScalerModel(Model, MaxAbsScalerParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        scale = np.where(self.max_abs > 0, self.max_abs, 1.0)
+        if isinstance(X, jax.Array):
+            scale = self.device_constants()["scale"]  # memoized upload
+        else:
+            scale = np.where(self.max_abs > 0, self.max_abs, 1.0)
         return [table.with_column(self.get_output_col(), X / scale[None, :])]
 
     def _save_extra(self, path: str) -> None:

@@ -19,7 +19,19 @@ from ...common.param import HasInputCol, HasOutputCol
 from ...param import DoubleParam, ParamValidators
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
+
+
+def _affine_impl(X, scale, offset):
+    """X * scale + offset — shared by the fused kernel and the eager device
+    path. Both must compile the SAME expression: XLA contracts a jitted
+    mul+add into an FMA, so an un-jitted eager mul-then-add would differ
+    from the fused program in the last ulp."""
+    return X * scale[None, :] + offset[None, :]
+
+
+_affine = lazy_jit(_affine_impl)
 
 
 class MinMaxScalerParams(HasInputCol, HasOutputCol):
@@ -44,9 +56,36 @@ class MinMaxScalerParams(HasInputCol, HasOutputCol):
 
 
 class MinMaxScalerModel(Model, MinMaxScalerParams):
+    fusable = True
+
     def __init__(self):
         self.min_vector: np.ndarray = None
         self.max_vector: np.ndarray = None
+
+    def _scale_offset(self):
+        """Transform affine coefficients, derived in host f64 (the eager
+        path's exact arithmetic — the kernel must not re-derive them in
+        on-device f32)."""
+        lo, hi = self.get_min(), self.get_max()
+        span = self.max_vector - self.min_vector
+        constant = np.abs(span) < 1.0e-5
+        scale = np.where(constant, 0.0, (hi - lo) / np.where(constant, 1.0, span))
+        offset = np.where(constant, (hi + lo) / 2.0, lo - self.min_vector * scale)
+        return scale, offset
+
+    def _constant_sources(self):
+        return (self.min_vector, self.max_vector)
+
+    def _kernel_constants(self):
+        scale, offset = self._scale_offset()
+        return {"scale": scale, "offset": offset}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        cols[self.get_output_col()] = _affine_impl(X, consts["scale"], consts["offset"])
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "MinMaxScalerModel":
         (model_data,) = inputs
@@ -70,12 +109,12 @@ class MinMaxScalerModel(Model, MinMaxScalerParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        lo, hi = self.get_min(), self.get_max()
-        span = self.max_vector - self.min_vector
-        constant = np.abs(span) < 1.0e-5
-        scale = np.where(constant, 0.0, (hi - lo) / np.where(constant, 1.0, span))
-        offset = np.where(constant, (hi + lo) / 2.0, lo - self.min_vector * scale)
-        out = X * scale[None, :] + offset[None, :]
+        if isinstance(X, jax.Array):
+            consts = self.device_constants()  # memoized upload per instance
+            out = _affine(X, consts["scale"], consts["offset"])
+        else:
+            scale, offset = self._scale_offset()
+            out = X * scale[None, :] + offset[None, :]
         return [table.with_column(self.get_output_col(), out)]
 
     def _save_extra(self, path: str) -> None:

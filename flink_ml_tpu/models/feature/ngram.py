@@ -28,6 +28,9 @@ class NGramParams(HasInputCol, HasOutputCol):
 
 
 class NGram(Transformer, NGramParams):
+    fusable = False
+    fusable_reason = "assembles n-gram strings from host token lists"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         n = self.get_n()

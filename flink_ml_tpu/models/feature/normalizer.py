@@ -36,6 +36,20 @@ def _normalize(X, p):
 
 
 class Normalizer(Transformer, NormalizerParams):
+    fusable = True
+
+    def _kernel_constants(self):
+        # np scalar (not python float): canonicalizes to the same dtype the
+        # eager path's jnp.asarray(p) produces under either x64 setting
+        return {"p": np.asarray(self.get_p())}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        cols[self.get_output_col()] = _normalize(X, consts["p"])
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

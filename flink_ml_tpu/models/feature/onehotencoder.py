@@ -59,8 +59,34 @@ class OneHotEncoderParams(OneHotEncoderModelParams):
 
 
 class OneHotEncoderModel(Model, OneHotEncoderModelParams):
+    fusable = True
+    kernel_emits_sparse = True
+
     def __init__(self):
         self.category_sizes: np.ndarray = None  # per-column max index + 1
+
+    def supports_fusion(self) -> bool:
+        # only handleInvalid='error' exists (reference contract); anything
+        # else raises eagerly before any device work
+        return self.get_handle_invalid() == HasHandleInvalid.ERROR_INVALID
+
+    def _constant_sources(self):
+        return (self.category_sizes,)
+
+    def transform_kernel(self, consts, cols, ctx):
+        drop = 1 if self.get_drop_last() else 0
+        for i, (name, out_name) in enumerate(
+            zip(self.get_input_cols(), self.get_output_cols())
+        ):
+            vec_size = int(self.category_sizes[i]) - drop
+            indices, values, bad = _onehot_impl(cols[name], vec_size, bool(drop))
+            ctx.guard(
+                bad,
+                f"The input contains an invalid (non-integer, negative "
+                f"or out-of-range) index in column {name}.",
+            )
+            cols[out_name] = SparseBatch(vec_size, indices, values)
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "OneHotEncoderModel":
         (model_data,) = inputs
@@ -102,6 +128,9 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
                 # device column: encode on device; one scalar probe
                 # validates (indexed integer, in range) without pulling
                 indices, values, bad = _onehot_kernel(col, vec_size, bool(drop))
+                from ...obs import tracing
+
+                tracing.account_host_sync("transform")
                 if bool(bad):
                     raise ValueError(
                         f"The input contains an invalid (non-integer, negative "

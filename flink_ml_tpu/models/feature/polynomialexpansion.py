@@ -75,6 +75,17 @@ _expand_device = keyed_jit(
 
 
 class PolynomialExpansion(Transformer, PolynomialExpansionParams):
+    fusable = True
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        # _expand_columns is trace-safe: the recursion emits jnp monomial
+        # columns for tracer inputs, fused into one elementwise kernel
+        cols[self.get_output_col()] = _expand_columns(X, self.get_degree())
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         import jax
 

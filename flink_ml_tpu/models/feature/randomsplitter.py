@@ -41,6 +41,9 @@ class RandomSplitterParams(HasSeed):
 
 
 class RandomSplitter(AlgoOperator, RandomSplitterParams):
+    fusable = False
+    fusable_reason = "1-to-many split with data-dependent per-output row counts (host RNG + boolean take)"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         weights = np.asarray(self.get_weights(), dtype=np.float64)

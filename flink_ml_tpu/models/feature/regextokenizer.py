@@ -57,6 +57,9 @@ class RegexTokenizerParams(HasInputCol, HasOutputCol):
 
 
 class RegexTokenizer(Transformer, RegexTokenizerParams):
+    fusable = False
+    fusable_reason = "host regex matching over a string column"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         pattern = re.compile(self.get_pattern())

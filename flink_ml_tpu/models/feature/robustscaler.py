@@ -75,9 +75,31 @@ class RobustScalerParams(RobustScalerModelParams, HasRelativeError):
 
 
 class RobustScalerModel(Model, RobustScalerModelParams):
+    fusable = True
+
     def __init__(self):
         self.medians: np.ndarray = None
         self.ranges: np.ndarray = None
+
+    def _constant_sources(self):
+        return (self.medians, self.ranges)
+
+    def _kernel_constants(self):
+        return {
+            "medians": self.medians,
+            "scale": np.where(self.ranges > 0, self.ranges, 1.0),
+        }
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        out = as_kernel_matrix(cols[self.get_input_col()])
+        if self.get_with_centering():
+            out = out - consts["medians"][None, :]
+        if self.get_with_scaling():
+            out = out / consts["scale"][None, :]
+        cols[self.get_output_col()] = out
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "RobustScalerModel":
         (model_data,) = inputs
@@ -101,11 +123,16 @@ class RobustScalerModel(Model, RobustScalerModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
+        if isinstance(X, jax.Array):
+            consts = self.device_constants()  # memoized upload per instance
+            medians, scale = consts["medians"], consts["scale"]
+        else:
+            medians = self.medians
+            scale = np.where(self.ranges > 0, self.ranges, 1.0)
         out = X
         if self.get_with_centering():
-            out = out - self.medians[None, :]
+            out = out - medians[None, :]
         if self.get_with_scaling():
-            scale = np.where(self.ranges > 0, self.ranges, 1.0)
             out = out / scale[None, :]
         return [table.with_column(self.get_output_col(), out)]
 

@@ -24,6 +24,9 @@ from ...table import Table
 
 
 class SQLTransformer(Transformer):
+    fusable = False
+    fusable_reason = "interprets a SQL statement over host rows (arbitrary expressions, aggregates, row filters)"
+
     STATEMENT = StringParam(
         "statement", "SQL statement.", None, ParamValidators.not_null()
     )

@@ -56,9 +56,29 @@ def _fit_stats(X):
 
 
 class StandardScalerModel(Model, StandardScalerParams):
+    fusable = True
+
     def __init__(self):
         self.mean: np.ndarray = None
         self.std: np.ndarray = None
+
+    def _constant_sources(self):
+        return (self.mean, self.std)
+
+    def _kernel_constants(self):
+        # scale derived in host f64 exactly as the eager path computes it
+        return {"mean": self.mean, "scale": np.where(self.std > 0, self.std, 1.0)}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        out = as_kernel_matrix(cols[self.get_input_col()])
+        if self.get_with_mean():
+            out = out - consts["mean"]
+        if self.get_with_std():
+            out = out / consts["scale"]
+        cols[self.get_output_col()] = out
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "StandardScalerModel":
         (model_data,) = inputs
@@ -75,11 +95,17 @@ class StandardScalerModel(Model, StandardScalerParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
+        if isinstance(X, jax.Array):
+            # device path: memoized device-resident constants — repeated
+            # transforms stop re-uploading mean/scale every call
+            consts = self.device_constants()
+            mean, scale = consts["mean"], consts["scale"]
+        else:
+            mean, scale = self.mean, np.where(self.std > 0, self.std, 1.0)
         out = X
         if self.get_with_mean():
-            out = out - self.mean
+            out = out - mean
         if self.get_with_std():
-            scale = np.where(self.std > 0, self.std, 1.0)
             out = out / scale
         return [table.with_column(self.get_output_col(), out)]
 

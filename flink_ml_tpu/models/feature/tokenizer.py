@@ -32,6 +32,9 @@ def _split_one(s: str) -> list:
 
 
 class Tokenizer(Transformer, TokenizerParams):
+    fusable = False
+    fusable_reason = "host string splitting"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         col = table.column(self.get_input_col())

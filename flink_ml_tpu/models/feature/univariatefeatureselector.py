@@ -122,8 +122,21 @@ def select_indices_from_p_values(
 
 
 class UnivariateFeatureSelectorModel(Model, UnivariateFeatureSelectorModelParams):
+    fusable = True
+
     def __init__(self):
         self.indices: np.ndarray = None
+
+    def _constant_sources(self):
+        return (self.indices,)
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+        from ...ops.selection import select_columns
+
+        X = as_kernel_matrix(cols[self.get_features_col()])
+        cols[self.get_output_col()] = select_columns(X, self.indices)
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "UnivariateFeatureSelectorModel":
         (model_data,) = inputs

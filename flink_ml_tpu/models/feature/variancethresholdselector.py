@@ -42,8 +42,23 @@ class VarianceThresholdSelectorParams(VarianceThresholdSelectorModelParams):
 
 
 class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams):
+    fusable = True
+
     def __init__(self):
         self.indices: np.ndarray = None  # kept feature indices
+
+    def _constant_sources(self):
+        return (self.indices,)
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+        from ...ops.selection import select_columns
+
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        if self.indices.size > 0 and self.indices.max() >= X.shape[1]:
+            raise ValueError("Model feature count does not match input vector size")
+        cols[self.get_output_col()] = select_columns(X, self.indices)
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "VarianceThresholdSelectorModel":
         (model_data,) = inputs

@@ -45,6 +45,40 @@ class VectorAssemblerParams(HasInputCols, HasOutputCol, HasHandleInvalid):
 
 
 class VectorAssembler(Transformer, VectorAssemblerParams):
+    fusable = True
+
+    def supports_fusion(self) -> bool:
+        # 'skip' drops NaN rows — a data-dependent row count
+        return self.get_handle_invalid() != HasHandleInvalid.SKIP_INVALID
+
+    def transform_kernel(self, consts, cols, ctx):
+        import jax.numpy as jnp
+
+        from ...api import as_kernel_matrix
+
+        in_cols = self.get_input_cols()
+        if not in_cols:
+            raise ValueError("Parameter inputCols must be set")
+        sizes = self.get_input_sizes()
+        mats = []
+        for i, name in enumerate(in_cols):
+            m = as_kernel_matrix(cols[name])
+            if sizes is not None and m.shape[1] != sizes[i]:
+                raise ValueError(
+                    f"Input column {name} has size {m.shape[1]}, "
+                    f"declared inputSizes[{i}] = {sizes[i]}"
+                )
+            mats.append(m)
+        out = jnp.concatenate(mats, axis=1)
+        if self.get_handle_invalid() == HasHandleInvalid.ERROR_INVALID:
+            ctx.guard(
+                jnp.isnan(out).any(),
+                "Encountered NaN while assembling a row with handleInvalid = 'error'. "
+                "Consider removing NaNs from dataset or using handleInvalid = 'keep' or 'skip'.",
+            )
+        cols[self.get_output_col()] = out
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         in_cols = self.get_input_cols()
@@ -68,6 +102,9 @@ class VectorAssembler(Transformer, VectorAssemblerParams):
             # flag is the only readback unless rows must be skipped
             out, any_bad = _assemble_kernel(*mats)
             result = table.with_column(self.get_output_col(), out)
+            from ...obs import tracing
+
+            tracing.account_host_sync("transform")
             if bool(any_bad):
                 if handle == HasHandleInvalid.ERROR_INVALID:
                     raise ValueError(

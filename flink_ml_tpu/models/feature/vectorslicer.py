@@ -45,6 +45,23 @@ class VectorSlicerParams(HasInputCol, HasOutputCol):
 
 
 class VectorSlicer(Transformer, VectorSlicerParams):
+    fusable = True
+
+    def transform_kernel(self, consts, cols, ctx):
+        from ...api import as_kernel_matrix
+
+        indices = self.get_indices()
+        if indices is None:
+            raise ValueError("Parameter indices must be set")
+        X = as_kernel_matrix(cols[self.get_input_col()])
+        idx = np.asarray(indices, dtype=np.int64)
+        if idx.max() >= X.shape[1]:
+            raise ValueError(
+                f"Index {int(idx.max())} out of range for vector size {X.shape[1]}"
+            )
+        cols[self.get_output_col()] = X[:, idx]
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         indices = self.get_indices()

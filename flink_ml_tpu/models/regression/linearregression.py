@@ -53,8 +53,28 @@ class LinearRegressionParams(
 
 
 class LinearRegressionModel(Model, LinearRegressionModelParams):
+    fusable = True
+    kernel_supports_sparse = True
+
     def __init__(self):
         self.coefficient: np.ndarray = None  # (d,)
+
+    def _constant_sources(self):
+        return (self.coefficient,)
+
+    def _kernel_constants(self):
+        # f32 to match the eager path's jnp.asarray(coeff, float32) under
+        # either x64 setting
+        return {"coefficient": np.asarray(self.coefficient, np.float32)}
+
+    def transform_kernel(self, consts, cols, ctx):
+        from .. import _linear
+
+        col = cols[self.get_features_col()]
+        cols[self.get_prediction_col()] = _linear.raw_scores(
+            col, consts["coefficient"]
+        )
+        return cols
 
     def set_model_data(self, *inputs: Table) -> "LinearRegressionModel":
         (model_data,) = inputs
@@ -72,7 +92,12 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
         col = table.column(self.get_features_col())
         from .. import _linear
 
-        pred = _linear.raw_scores(col, jnp.asarray(self.coefficient, jnp.float32))
+        coeff = (
+            self.device_constants()["coefficient"]  # memoized upload
+            if _linear.is_device_column(col)
+            else jnp.asarray(self.coefficient, jnp.float32)
+        )
+        pred = _linear.raw_scores(col, coeff)
         # device in -> device out (the LR/SVC convention): materializing
         # here would pull the whole prediction vector through the tunnel
         if not _linear.is_device_column(col):

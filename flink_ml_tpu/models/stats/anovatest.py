@@ -23,6 +23,9 @@ class ANOVATestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
 
 
 class ANOVATest(AlgoOperator, ANOVATestParams):
+    fusable = False
+    fusable_reason = "aggregate statistic: reduces the input to a single results row, not a record-wise transform"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)

@@ -24,6 +24,9 @@ class ChiSqTestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
 
 
 class ChiSqTest(AlgoOperator, ChiSqTestParams):
+    fusable = False
+    fusable_reason = "aggregate statistic: reduces the input to a single results row, not a record-wise transform"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()))

@@ -23,6 +23,9 @@ class FValueTestParams(HasFeaturesCol, HasLabelCol, HasFlatten):
 
 
 class FValueTest(AlgoOperator, FValueTestParams):
+    fusable = False
+    fusable_reason = "aggregate statistic: reduces the input to a single results row, not a record-wise transform"
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)

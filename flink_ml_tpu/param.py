@@ -277,6 +277,10 @@ class WithParams:
         if value is not None:
             param.validate(value)
         params[param] = value
+        # monotone token consumed by the fusion planner and the device-
+        # constant cache (api.AlgoOperator.device_constants): a param change
+        # invalidates compiled transform plans that baked the old value
+        self.__dict__["_params_version"] = self.__dict__.get("_params_version", 0) + 1
         return self
 
     def get(self, param: Param):

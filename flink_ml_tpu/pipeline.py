@@ -1,22 +1,34 @@
-"""Pipeline / PipelineModel — sequential stage composition.
+"""Pipeline / PipelineModel — sequential stage composition + transform fusion.
 
 Mirrors flink-ml-core/.../builder/Pipeline.java:79-107 and
 PipelineModel.java:63-68: `Pipeline.fit` trains each Estimator on the data
 as transformed by all earlier stages, producing a `PipelineModel` of the
 trained models; `PipelineModel.transform` folds inputs through every stage.
-Execution here is eager (each stage consumes materialized columnar tables);
-there is no lazy client graph because there is no remote cluster to submit
-to — XLA compilation inside each stage is the deferred-execution layer.
+
+Execution of `fit` is eager (each stage consumes materialized columnar
+tables). `transform` is where the serving hot path lives, and dispatching
+each stage as its own XLA program pays the remote tunnel's fixed
+dispatch+readback latency once per stage — the per-stage overhead that
+dominates distributed ML runtime in the Spark study (arXiv:1612.01437).
+So `PipelineModel.transform` runs a **fusion planner**: consecutive stages
+that expose the transform-kernel protocol (api.AlgoOperator) are
+partitioned into maximal segments, each segment's composed kernel is
+jitted ONCE, and the column pytree threads through the whole segment in
+HBM — one device program per segment instead of one per stage, outputs
+bit-identical to the eager path. Host-only stages break segments; guard
+predicates (deferred validation) come back in one packed readback at the
+pipeline exit or host-segment boundary.
 """
 
 from __future__ import annotations
 
-import os
-from typing import List, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from .api import AlgoOperator, Estimator, Model, Stage
+import numpy as np
+
+from .api import AlgoOperator, Estimator, KernelContext, Model, Stage
 from .obs import tracing
-from .table import Table
+from .table import SparseBatch, Table
 from .utils import metrics, read_write
 
 
@@ -27,8 +39,170 @@ def _transform_one(stage: Stage, table: Table) -> Table:
     return outputs[0]
 
 
+# ---------------------------------------------------------------------------
+# fusion planner
+# ---------------------------------------------------------------------------
+
+class _DensePlaceholder:
+    """Stand-in for a dense column produced earlier in a segment (no array
+    exists until the program runs); kernels' readiness hooks may only rely
+    on `dtype`, which is the jit default float."""
+
+    dtype = np.dtype("float32")
+
+
+_DENSE = _DensePlaceholder()
+_SPARSE = object()  # sparse placeholder: kind-only
+
+
+def _column_kind(col) -> str:
+    """'dense' (device array), 'sparse' (device SparseBatch) or 'host'."""
+    import jax
+
+    if isinstance(col, SparseBatch):
+        return "sparse" if isinstance(col.indices, jax.Array) else "host"
+    if isinstance(col, jax.Array):
+        return "dense"
+    return "host"
+
+
+def _stage_is_fusable(stage: Stage) -> bool:
+    return (
+        isinstance(stage, AlgoOperator)
+        and stage.supports_fusion()
+        and type(stage).transform_kernel is not AlgoOperator.transform_kernel
+    )
+
+
+class FusedSegment:
+    """A maximal run of fusable stages compiled as one device program."""
+
+    def __init__(self, indexed_stages: Sequence[Tuple[int, Stage]]):
+        self.indices = [i for i, _ in indexed_stages]
+        self.stages: List[AlgoOperator] = [s for _, s in indexed_stages]
+        self._jit = None
+        # guard messages in program-output order; captured at trace time
+        # (fixed for a given stage list — every compiled signature of this
+        # segment registers the same guards)
+        self._guard_messages: List[str] = []
+
+    @property
+    def start(self) -> int:
+        return self.indices[0]
+
+    def ready_feed(self, table: Table) -> Optional[Dict[str, Any]]:
+        """The columns to feed the segment program, or None when the segment
+        cannot run fused on this table (host-resident inputs, a column kind
+        a stage's kernel doesn't handle, or a stage-specific veto)."""
+        produced: Dict[str, Any] = {}
+        feed: Dict[str, Any] = {}
+        for stage in self.stages:
+            view: Dict[str, Any] = {}
+            for name in stage.kernel_input_cols():
+                if name in produced:
+                    col = produced[name]
+                    kind = "sparse" if col is _SPARSE else "dense"
+                elif name in table:
+                    col = table.column(name)
+                    kind = _column_kind(col)
+                    if kind == "host":
+                        return None
+                    feed[name] = col
+                else:
+                    return None
+                if kind == "sparse" and not stage.kernel_supports_sparse:
+                    return None
+                view[name] = col
+            if not stage.kernel_ready(view):
+                return None
+            out_marker = _SPARSE if stage.kernel_emits_sparse else _DENSE
+            for name in stage.kernel_output_cols():
+                produced[name] = out_marker
+        return feed
+
+    def _run(self, consts_list, cols):
+        import jax
+        import jax.numpy as jnp
+
+        ctx = KernelContext()
+        for stage, consts in zip(self.stages, consts_list):
+            cols = stage.transform_kernel(consts, dict(cols), ctx)
+            # pin the stage boundary: XLA must not contract/reassociate ops
+            # ACROSS stages (e.g. FMA-fusing one stage's affine into the
+            # next stage's reduction), or fused outputs drift a last-ulp
+            # from the per-stage eager path — the bit-parity guarantee is
+            # per-stage compilation regions inside ONE device program
+            cols = jax.lax.optimization_barrier(cols)
+        # guards pack into ONE program output vector: the eventual drain is
+        # a single device_get with no host-side packing dispatches
+        self._guard_messages = list(ctx.guards)
+        guard_vec = (
+            jnp.stack([jnp.asarray(v, jnp.bool_) for v in ctx.guards.values()])
+            if ctx.guards
+            else jnp.zeros((0,), jnp.bool_)
+        )
+        return cols, guard_vec
+
+    def execute(
+        self, table: Table, feed: Dict[str, Any], pending: List[Tuple[Tuple[str, ...], Any]]
+    ) -> Table:
+        if self._jit is None:
+            import jax
+
+            self._jit = jax.jit(self._run)
+            # stable for this plan's lifetime: a constant/param change
+            # invalidates the whole plan (PipelineModel._fusion_plan token)
+            self._consts_list = [stage.device_constants() for stage in self.stages]
+        out_cols, guard_vec = self._jit(self._consts_list, feed)
+        if self._guard_messages:
+            pending.append((tuple(self._guard_messages), guard_vec))
+        return table.with_columns(out_cols)
+
+
+class _FusionPlan:
+    """Partition of a stage list into fused segments and eager runs."""
+
+    def __init__(self, stages: Sequence[Stage]):
+        self.runs: List[Tuple[str, Any]] = []  # ("fused", seg) | ("eager", i, stage)
+        buf: List[Tuple[int, Stage]] = []
+        for i, stage in enumerate(stages):
+            if _stage_is_fusable(stage):
+                buf.append((i, stage))
+            else:
+                if buf:
+                    self.runs.append(("fused", FusedSegment(buf)))
+                    buf = []
+                self.runs.append(("eager", i, stage))
+        if buf:
+            self.runs.append(("fused", FusedSegment(buf)))
+        self.has_fusable = any(kind == "fused" for kind, *_ in self.runs)
+
+
+def _drain_guards(pending: List[Tuple[Tuple[str, ...], Any]]) -> None:
+    """ONE packed readback of every accumulated guard vector (one vector
+    per executed segment); raises the first registered message whose
+    predicate fired. Accounted as a transform-path host sync — the only
+    blocking point a fused pipeline transform has."""
+    if not pending:
+        return
+    from .utils.packing import packed_device_get
+
+    vectors = packed_device_get(*[v for _, v in pending], sync_kind="transform")
+    entries = list(pending)
+    pending.clear()
+    for (messages, _), values in zip(entries, vectors):
+        for message, value in zip(messages, np.asarray(values)):
+            if bool(value):
+                raise ValueError(message)
+
+
 class PipelineModel(Model):
     """Model produced by Pipeline.fit (builder/PipelineModel.java)."""
+
+    # the composite itself never fuses as a unit; fusion happens INSIDE its
+    # own transform across the member stages' kernels
+    fusable = False
+    fusable_reason = "composite stage: fusion runs across its member stages"
 
     def __init__(self, stages: Sequence[Stage] = ()):
         self._stages: List[Stage] = list(stages)
@@ -37,20 +211,111 @@ class PipelineModel(Model):
     def stages(self) -> List[Stage]:
         return self._stages
 
+    def _fusion_plan(self) -> _FusionPlan:
+        """The cached segment plan; invalidated when the stage list, any
+        stage's params, or any stage's model arrays change (a jitted segment
+        bakes params and array identities at trace time)."""
+        token = tuple(
+            (
+                id(stage),
+                stage.__dict__.get("_params_version", 0),
+                tuple(id(a) for a in stage._constant_sources())
+                if isinstance(stage, AlgoOperator)
+                else (),
+            )
+            for stage in self._stages
+        )
+        cached = self.__dict__.get("_plan_cache")
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        plan = _FusionPlan(self._stages)
+        self.__dict__["_plan_cache"] = (token, plan)
+        return plan
+
+    def _run_eager(self, index: int, stage: Stage, table: Table) -> Table:
+        with tracing.span(
+            "pipeline.stage",
+            index=index,
+            stage=type(stage).__name__,
+            op="transform",
+        ):
+            return _transform_one(stage, table)
+
+    def _transform_fused(
+        self, table: Table, pending: List[Tuple[str, Any]]
+    ) -> Table:
+        """Run the fusion plan: fused segments dispatch as single programs;
+        segments that aren't device-ready for this table, and non-fusable
+        stages, run eagerly. Guards accumulate in `pending` and are drained
+        before any eager (host-visible) work and by the caller at exit."""
+        from .table import register_device_pytrees
+
+        register_device_pytrees()
+        plan = self._fusion_plan()
+        fused_segments = 0
+        fused_stages = 0
+        for run in plan.runs:
+            if run[0] == "fused":
+                seg: FusedSegment = run[1]
+                feed = seg.ready_feed(table)
+                if feed is not None:
+                    with tracing.span(
+                        "pipeline.segment",
+                        index=seg.start,
+                        stages=",".join(type(s).__name__ for s in seg.stages),
+                        numStages=len(seg.stages),
+                        op="transform",
+                        fused=True,
+                    ):
+                        table = seg.execute(table, feed, pending)
+                    fused_segments += 1
+                    fused_stages += len(seg.stages)
+                    continue
+                # not device-ready: the whole segment falls back to eager
+                _drain_guards(pending)
+                for i, stage in zip(seg.indices, seg.stages):
+                    table = self._run_eager(i, stage, table)
+            else:
+                _, i, stage = run
+                _drain_guards(pending)
+                table = self._run_eager(i, stage, table)
+        metrics.set_gauge("pipeline.fused_segments", fused_segments)
+        metrics.set_gauge("pipeline.fused_stages", fused_stages)
+        return table
+
     def transform(self, *inputs: Table) -> List[Table]:
         if len(inputs) != 1:
             raise ValueError("PipelineModel.transform expects exactly 1 input table")
         table = inputs[0]
+        from . import config
+
         with metrics.timed("pipeline.transform"):
-            for i, stage in enumerate(self._stages):
-                with tracing.span(
-                    "pipeline.stage",
-                    index=i,
-                    stage=type(stage).__name__,
-                    op="transform",
-                ):
-                    table = _transform_one(stage, table)
+            if config.pipeline_fusion == "off":
+                for i, stage in enumerate(self._stages):
+                    table = self._run_eager(i, stage, table)
+            else:
+                pending: List[Tuple[str, Any]] = []
+                table = self._transform_fused(table, pending)
+                _drain_guards(pending)
         return [table]
+
+    def transform_deferred(self, table: Table) -> Tuple[Table, List[Tuple[str, Any]]]:
+        """Fused transform WITHOUT the exit guard drain: returns the output
+        table (device-resident columns still in flight) plus the pending
+        (message, device-scalar) guards. The serving runner uses this to
+        overlap the next batch's upload/compute with this batch's pending
+        validation, draining guards only when the batch leaves its bounded
+        in-flight window (parallel/dispatch.py DrainQueue pattern)."""
+        from . import config
+
+        pending: List[Tuple[str, Any]] = []
+        with metrics.timed("pipeline.transform"):
+            if config.pipeline_fusion == "off":
+                for i, stage in enumerate(self._stages):
+                    table = self._run_eager(i, stage, table)
+            else:
+                table = self._transform_fused(table, pending)
+        return table, pending
 
     def save(self, path: str) -> None:
         read_write.save_metadata(self, path, {"numStages": len(self._stages)})

@@ -1,0 +1,202 @@
+"""Micro-batch serving — double-buffered fused pipeline inference.
+
+The throughput path the ROADMAP north star asks for: drive a fused
+`PipelineModel` transform plan (pipeline.py) over an unbounded stream of
+mini-batches at a bounded, stage-count-independent host-sync cost. Two
+mechanisms on top of the fusion planner:
+
+1. **Bucket padding** — a jitted segment program is specialized to its
+   input shapes, so free-running batch sizes would recompile every batch.
+   Each incoming batch is padded up to the smallest configured bucket
+   (default: powers of two) by REPEATING ITS LAST ROW; compile count is
+   bounded by the number of buckets, and the padding rows are copies of a
+   real row, so they can never fire a validation guard the real data
+   would not. Outputs are sliced back to the true row count on device.
+
+2. **Bounded in-flight window** — the transform of batch i is dispatched
+   with its exit guard drain DEFERRED (PipelineModel.transform_deferred),
+   and the (output, pending-guards) pair parks in a bounded queue, the
+   DrainQueue pattern of parallel/dispatch.py. Batch i+1's H2D upload and
+   segment dispatch overlap batch i's device compute; the single blocking
+   guard readback happens only when a batch leaves the window. Per-batch
+   host syncs are therefore O(1) regardless of pipeline depth.
+
+Results are yielded IN ORDER. A batch's guard failure (e.g. Bucketizer
+handleInvalid='error') raises when that batch is yielded — at most
+`in_flight` batches later than the eager path would have raised, never
+reordered and never dropped.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import config
+from .obs import tracing
+from .pipeline import PipelineModel, _drain_guards
+from .table import SparseBatch, Table
+from .utils import metrics
+
+__all__ = ["MicroBatchServer", "serve_stream"]
+
+
+def _next_bucket(n: int, buckets: Optional[Sequence[int]]) -> int:
+    """Smallest bucket >= n. Default schedule: powers of two (>= 8), the
+    classic recompile-bounding shape schedule; an explicit sorted bucket
+    list wins when the traffic distribution is known."""
+    if n <= 0:
+        return n  # empty batch: nothing to pad
+    if buckets:
+        for b in buckets:
+            if b >= n:
+                return int(b)
+        return int(n)  # beyond the largest bucket: exact shape
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _pad_rows(col, n: int, bucket: int):
+    """Pad a column from n to bucket rows by repeating its final row (a
+    real row: guard-safe). Works for host numpy, device arrays and
+    SparseBatch; object columns pad on host."""
+    if bucket == n:
+        return col
+    if isinstance(col, SparseBatch):
+        return SparseBatch(
+            col.size,
+            _pad_rows(col.indices, n, bucket),
+            _pad_rows(col.values, n, bucket),
+        )
+    try:
+        import jax
+
+        if isinstance(col, jax.Array):
+            import jax.numpy as jnp
+
+            reps = jnp.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
+            return jnp.concatenate([col, reps])
+    except ImportError:  # pragma: no cover
+        pass
+    col = np.asarray(col)
+    reps = np.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
+    return np.concatenate([col, reps])
+
+
+def _slice_rows(col, n: int):
+    if isinstance(col, SparseBatch):
+        return SparseBatch(col.size, col.indices[:n], col.values[:n])
+    return col[:n]
+
+
+class MicroBatchServer:
+    """Drives a PipelineModel's fused transform plan over a batch stream.
+
+    `in_flight` bounds the transformed-but-undrained window (default
+    `config.serving_in_flight`); `buckets` optionally pins the padded
+    batch-shape schedule (sorted ascending), otherwise batches pad to the
+    next power of two. `device_input=True` uploads each padded batch's
+    numeric host columns to device HBM before dispatch, so the whole
+    pipeline — upload included — runs ahead of the previous batch's drain.
+    """
+
+    def __init__(
+        self,
+        model: PipelineModel,
+        in_flight: Optional[int] = None,
+        buckets: Optional[Sequence[int]] = None,
+        device_input: bool = True,
+    ):
+        if not isinstance(model, PipelineModel):
+            raise TypeError(f"MicroBatchServer serves a PipelineModel, got {type(model).__name__}")
+        self.model = model
+        self.in_flight = max(1, int(in_flight if in_flight is not None else config.serving_in_flight))
+        self.buckets = sorted(int(b) for b in buckets) if buckets else None
+        self.device_input = device_input
+        self._buckets_seen: set = set()
+
+    # -- batch staging -------------------------------------------------------
+    def _stage_batch(self, batch: Table) -> Tuple[Table, int]:
+        """Pad `batch` to its bucket and (optionally) upload numeric host
+        columns — the H2D leg of the double buffer. All uploadable columns
+        go through ONE `device_put` call (per-column puts would each pay a
+        dispatch; on a remote-attached device, a round trip)."""
+        n = batch.num_rows
+        bucket = _next_bucket(n, self.buckets)
+        self._buckets_seen.add(bucket)
+        cols: Dict[str, Any] = {}
+        uploads: Dict[str, Any] = {}
+        for name in batch.column_names:
+            col = _pad_rows(batch.column(name), n, bucket)
+            if self.device_input and self._uploadable(col):
+                uploads[name] = col
+            else:
+                cols[name] = col
+        if uploads:
+            import jax
+
+            from .table import register_device_pytrees
+
+            register_device_pytrees()  # SparseBatch uploads as a pytree
+            uploads = jax.device_put(uploads)
+        return Table(
+            {name: uploads.get(name, cols.get(name)) for name in batch.column_names}
+        ), n
+
+    @staticmethod
+    def _uploadable(col) -> bool:
+        if isinstance(col, SparseBatch):
+            return isinstance(col.indices, np.ndarray)
+        return (
+            isinstance(col, np.ndarray)
+            and col.dtype != object
+            and col.dtype.kind not in ("U", "S")
+        )
+
+    def _finish(self, out: Table, pending: List[Tuple[str, Any]], n: int) -> Table:
+        """Retire one batch from the in-flight window: ONE packed guard
+        readback (the batch's only blocking sync), then slice the padding
+        off on device."""
+        _drain_guards(pending)
+        if out.num_rows == n:
+            return out
+        return Table({name: _slice_rows(out.column(name), n) for name in out.column_names})
+
+    # -- the serving loop ----------------------------------------------------
+    def serve(self, stream: Iterable[Table]) -> Iterator[Table]:
+        """Transform every batch of `stream`, yielding output Tables in
+        input order. Output columns may be device-resident; callers that
+        need host values materialize them (that readback is theirs)."""
+        window: deque = deque()
+        num_batches = 0
+        num_records = 0
+        metrics.set_gauge("serving.in_flight", self.in_flight)
+        for batch in stream:
+            with tracing.span("serving.batch", index=num_batches, op="dispatch"):
+                staged, n = self._stage_batch(batch)
+                out, pending = self.model.transform_deferred(staged)
+            window.append((out, pending, n))
+            num_batches += 1
+            num_records += n
+            metrics.inc_counter("serving.batches")
+            metrics.inc_counter("serving.records", n)
+            if len(window) > self.in_flight:
+                yield self._finish(*window.popleft())
+            metrics.set_gauge("serving.buckets", len(self._buckets_seen))
+        while window:
+            yield self._finish(*window.popleft())
+        metrics.set_gauge("serving.buckets", len(self._buckets_seen))
+
+
+def serve_stream(
+    model: PipelineModel,
+    stream: Iterable[Table],
+    in_flight: Optional[int] = None,
+    buckets: Optional[Sequence[int]] = None,
+) -> List[Table]:
+    """One-shot convenience: serve the whole stream, collect the outputs."""
+    return list(MicroBatchServer(model, in_flight=in_flight, buckets=buckets).serve(stream))

@@ -28,6 +28,33 @@ def _is_jax_array(x) -> bool:
     except ImportError:  # pragma: no cover
         return False
 
+
+_pytrees_registered = False
+
+
+def register_device_pytrees() -> None:
+    """Register SparseBatch as a jax pytree (size = static treedef data,
+    indices/values = children) so sparse columns flow through jitted fused
+    transform segments without densifying. Deferred + idempotent: table.py
+    must stay importable without jax."""
+    global _pytrees_registered
+    if _pytrees_registered:
+        return
+    import jax
+
+    def _flatten(sb):
+        return (sb.indices, sb.values), sb.size
+
+    def _unflatten(size, children):
+        # bypass __init__: children are tracers during jit tracing
+        sb = object.__new__(SparseBatch)
+        sb.size = size
+        sb.indices, sb.values = children
+        return sb
+
+    jax.tree_util.register_pytree_node(SparseBatch, _flatten, _unflatten)
+    _pytrees_registered = True
+
 __all__ = [
     "Table",
     "StreamTable",
@@ -336,21 +363,37 @@ class Table:
                 if a.size != b.size:
                     raise ValueError("SparseBatch size mismatch in concat")
                 k = max(a.indices.shape[1], b.indices.shape[1])
+                # device-resident sparse columns pad/concat in HBM — np ops
+                # here would silently pull both operands to host
+                device = _is_jax_array(a.indices) or _is_jax_array(b.indices)
+                if device:
+                    import jax.numpy as xp
+                else:
+                    xp = np
 
                 def pad(sb: SparseBatch):
                     pad_k = k - sb.indices.shape[1]
+                    indices, values = sb.indices, sb.values
+                    if device:
+                        indices, values = xp.asarray(indices), xp.asarray(values)
                     if pad_k == 0:
-                        return sb.indices, sb.values
+                        return indices, values
                     return (
-                        np.pad(sb.indices, ((0, 0), (0, pad_k)), constant_values=-1),
-                        np.pad(sb.values, ((0, 0), (0, pad_k))),
+                        xp.pad(indices, ((0, 0), (0, pad_k)), constant_values=-1),
+                        xp.pad(values, ((0, 0), (0, pad_k))),
                     )
 
                 ia, va = pad(a)
                 ib, vb = pad(b)
                 out[name] = SparseBatch(
-                    a.size, np.concatenate([ia, ib]), np.concatenate([va, vb])
+                    a.size, xp.concatenate([ia, ib]), xp.concatenate([va, vb])
                 )
+            elif _is_jax_array(a) and _is_jax_array(b):
+                # both operands live on device: concat stays in HBM instead
+                # of two D2H pulls + a host concat + (for consumers) re-upload
+                import jax.numpy as jnp
+
+                out[name] = jnp.concatenate([a, b])
             else:
                 out[name] = np.concatenate([np.asarray(a), np.asarray(b)])
         return Table(out)

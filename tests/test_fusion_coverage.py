@@ -1,0 +1,42 @@
+"""Tier-1 gate for scripts/check_fusion_coverage.py: every concrete stage
+must either expose a transform kernel or explicitly opt out of fusion with
+a reason — a new stage cannot silently regress fusion coverage."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_fusion_coverage",
+        os.path.join(REPO, "scripts", "check_fusion_coverage.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_every_stage_declares_fusion_contract():
+    checker = _load_checker()
+    violations = checker.find_violations()
+    assert not violations, "stages violating the fusion contract:\n" + "\n".join(
+        f"  {name}: {problem}" for name, problem in violations
+    )
+
+
+def test_fusable_stages_are_nontrivial():
+    # the protocol is real: a healthy fraction of the stage population runs
+    # on the fused path (guards against mass opt-outs gaming the gate)
+    checker = _load_checker()
+    from flink_ml_tpu.api import AlgoOperator
+
+    classes = list(checker._iter_stage_classes())
+    with_kernel = [
+        c for c in classes if c.transform_kernel is not AlgoOperator.transform_kernel
+    ]
+    assert len(with_kernel) >= 20, (
+        f"only {len(with_kernel)} stages expose transform_kernel; "
+        "the fusion protocol should cover the high-traffic device stages"
+    )
