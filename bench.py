@@ -518,6 +518,56 @@ def bench_pipeline_serving(num_batches=48, batch_rows=4096):
     return result
 
 
+def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
+    """The comm-layer workload (ISSUE 4): per-device-count collective
+    traffic and wall time from scripts/bench_collectives.py — bucketed
+    all-reduce (chunk count + chunked vs monolithic wall), the SparCML
+    index-value gradient reduce at the sparseWideLR shape (sparse wire
+    bytes vs dense-equivalent — the traffic-proportionality number), and
+    a dense SGD fit with the overlap schedule off vs on (bit-identity
+    asserted in-process). Each device count needs its own jax backend
+    (xla_force_host_platform_device_count must win before jax initializes),
+    hence one subprocess per N — the dryrun_multichip substrate promoted
+    to a first-class BENCH entry. Skips gracefully when no multi-device
+    run fits the budget (the entry reports why instead of nulling out)."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "bench_collectives.py"
+    )
+    runs = {}
+    for n in device_counts:
+        if n < 2:
+            continue  # collectives need a second participant
+        if not in_budget():
+            runs[str(n)] = {"skipped": "budget"}
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, "--devices", str(n)],
+                capture_output=True,
+                text=True,
+                timeout=240,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr.strip().splitlines()[-1:] or "nonzero exit")
+            runs[str(n)] = json.loads(proc.stdout.strip().splitlines()[-1])
+            r = runs[str(n)]
+            log(
+                f"multichipCollectives[{n}]: {r['denseAllReduce']['chunkCount']} buckets, "
+                f"chunked {r['denseAllReduce']['chunkedMs']:.2f}ms vs mono "
+                f"{r['denseAllReduce']['monolithicMs']:.2f}ms; sparse ratio "
+                f"{r['sparseGradReduce']['sparseRatio']:.4f}; overlap SGD "
+                f"{r['overlapSgd']['overlapMs']:.0f}ms vs eager {r['overlapSgd']['eagerMs']:.0f}ms"
+            )
+        except Exception as e:
+            log(f"multichipCollectives[{n}] failed: {e!r}")
+            runs[str(n)] = {"skipped": repr(e)}
+    if not any("skipped" not in r for r in runs.values()):
+        return {"skipped": "no multi-device run completed", "runs": runs}
+    return {"substrate": "virtual_cpu_devices", "runs": runs}
+
+
 def main(argv):
     _enable_compilation_cache()
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -538,6 +588,7 @@ def main(argv):
         "sparseWideLR": None,
         "kmeans": None,
         "pipelineServing": None,
+        "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
 
@@ -612,6 +663,14 @@ def main(argv):
                 details["pipelineServing"] = bench_pipeline_serving()
             except Exception as e:
                 log(f"pipelineServing stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["multichipCollectives"] = bench_multichip_collectives(
+                    in_budget=in_budget
+                )
+            except Exception as e:
+                log(f"multichipCollectives stage failed: {e!r}")
 
         try:  # recorded separately by scripts/bench_sweep.py; attach summary
             sweep_path = os.path.join(

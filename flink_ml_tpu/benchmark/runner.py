@@ -153,8 +153,31 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # segments the transform phase fused (0 = eager per-stage path); a
         # drop between BENCH files means stages fell off the fused path
         "fusedSegments": int(delta["gauges"].get("pipeline.fused_segments", 0)),
+        # per-op collective traffic this entry traced (calls/bytes/chunks
+        # from the accounted wrappers in parallel/collectives.py, plus the
+        # sparse-vs-dense byte ratio when a sparse reduce ran) — the
+        # traffic-proportionality evidence next to the timing numbers
+        "collectiveBreakdown": collective_breakdown(delta),
         "metrics": delta,
     }
+
+
+def collective_breakdown(delta: Dict) -> Dict[str, Dict]:
+    """Reduce a metrics delta's `collective.<op>.{calls,bytes,chunks}`
+    counters into {op: {calls, bytes[, chunks]}} (+ `sparseRatio` from the
+    gauge). Empty dict when the entry dispatched no accounted collective."""
+    out: Dict[str, Dict] = {}
+    for name, value in delta.get("counters", {}).items():
+        parts = name.split(".")
+        if len(parts) != 3 or parts[0] != "collective":
+            continue
+        op, field = parts[1], parts[2]
+        if field in ("calls", "bytes", "chunks", "dense_equiv_bytes"):
+            out.setdefault(op, {})[field] = int(value)
+    ratio = delta.get("gauges", {}).get("collective.sparse_ratio")
+    if out and ratio is not None:
+        out["sparseRatio"] = ratio
+    return out
 
 
 def _adapt_input_columns(stage, input_tables: List[Table]) -> None:

@@ -39,6 +39,63 @@ def iteration_chunk_for(max_iter: int, chunk_size: Optional[int] = None) -> int:
     return max(1, min(int(k), max(1, int(max_iter))))
 
 
+# --- collectives: chunking, sparse reduction, comm/compute overlap ------------
+# (parallel/collectives.py + parallel/overlap.py)
+# Bucket size for all_reduce_sum_chunked: a large gradient pytree is
+# decomposed into size-targeted buckets and each bucket reduced on its own.
+# The reference hand-rolls the same decomposition at 32KB per chunk over
+# netty shuffles (AllReduceImpl.java:56-103, tuned for TCP framing); ICI
+# moves MB-class buckets at line rate, so the default is 4MB — small enough
+# that a multi-bucket reduce can pipeline, large enough to amortize
+# per-collective launch cost. None/0 = one bucket (no chunking).
+collective_chunk_bytes: Optional[int] = 4 << 20
+# Density threshold for the SparCML-style index-value gradient reduction:
+# the sparse path is used when its wire bytes (per-shard (index, value)
+# pairs) are at most this fraction of the dense-equivalent psum payload
+# (dim * itemsize); above it, the gradient densifies and rides the chunked
+# dense reduce. Decided at trace time from static shapes.
+collective_sparse_threshold: float = 0.5
+# Route each bucket through the ring-pipelined ppermute reduction instead
+# of reduce_scatter+all_gather. The ring rotates shard contributions and
+# folds them in replica order (bit-identical to psum), letting bucket i+1's
+# hops overlap bucket i's fold — the latency-bound small-bucket regime; the
+# default reduce_scatter+all_gather path is the bandwidth-optimal one.
+collective_ring: bool = False
+# Comm/compute overlap in the SGD/Lloyd training loops: the loop carries
+# the UNREDUCED per-shard gradient and defers its all-reduce to the top of
+# the next epoch, so the reduction of batch b's gradient overlaps the
+# forward of batch b+1 (carry-delayed apply; bit-identical by construction
+# — see docs/performance.md §7 and tests/test_collective_chunks.py).
+collective_overlap: bool = False
+
+
+@contextmanager
+def collective_overlap_mode(enabled: bool = True):
+    """Scoped override of `collective_overlap`."""
+    global collective_overlap
+    prev = collective_overlap
+    collective_overlap = bool(enabled)
+    try:
+        yield
+    finally:
+        collective_overlap = prev
+
+
+def resolve_chunk_bytes(chunk_bytes: Optional[int] = None) -> Optional[int]:
+    """Effective collective bucket size: explicit argument > process-wide
+    `collective_chunk_bytes`. None/<=0 means unchunked (one bucket)."""
+    v = chunk_bytes if chunk_bytes is not None else collective_chunk_bytes
+    if v is None or v <= 0:
+        return None
+    return int(v)
+
+
+if os.environ.get("FLINK_ML_TPU_COLLECTIVE_OVERLAP") in ("1", "true", "on"):
+    collective_overlap = True
+if os.environ.get("FLINK_ML_TPU_COLLECTIVE_CHUNK_BYTES"):
+    collective_chunk_bytes = int(os.environ["FLINK_ML_TPU_COLLECTIVE_CHUNK_BYTES"])
+
+
 # --- pipeline transform fusion (pipeline.py) ----------------------------------
 # "auto": PipelineModel.transform compiles maximal runs of fusable stages
 # into single device programs when their input columns are device-resident

@@ -266,9 +266,23 @@ class KMeans(Estimator, KMeansParams):
         # centroids are all fit-owned buffers consumed by the train loop —
         # donate them so Lloyd ping-pongs in the same HBM instead of
         # holding a second copy of the dataset for the whole fit
-        train = (
-            _lloyd_train_donating if dispatch.supports_donation() else _lloyd_train
-        )
+        from ... import config
+
+        if config.collective_overlap:
+            # overlap-scheduled Lloyd: epoch e's centroid-partial reduce
+            # rides the chunked collective under epoch e+1's distance
+            # matmul (parallel/overlap.py; bit-identical to _lloyd_train)
+            from ...parallel import overlap
+
+            def train(X, w, init, max_iter, measure):
+                return overlap.overlapped_lloyd_train(
+                    mesh, X, w, init, max_iter, measure
+                )
+
+        else:
+            train = (
+                _lloyd_train_donating if dispatch.supports_donation() else _lloyd_train
+            )
         with tracing.span(
             "iteration.run", mode="device", epochs=self.get_max_iter()
         ):

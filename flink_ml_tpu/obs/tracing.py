@@ -242,6 +242,43 @@ def account_readback(nbytes: int, seconds: float, arrays: int = 1) -> None:
         )
 
 
+def account_collective(
+    op: str,
+    nbytes: int,
+    chunks: int,
+    axis: str,
+    dense_equiv_bytes: int = None,
+) -> None:
+    """Fold one collective call into the registry (+ a trace event). Fired
+    at TRACE time by the wrappers in parallel/collectives.py — once per
+    compiled program, when the op's shapes are known. `nbytes` is the
+    per-participant payload; `chunks` the bucket/leaf count the payload was
+    decomposed into. For sparse index-value reductions `dense_equiv_bytes`
+    is the payload the densified gradient would have moved; the running
+    `collective.sparse_ratio` gauge (sparse bytes / dense-equivalent bytes
+    across every sparse reduce traced so far) is THE traffic-proportionality
+    metric: << 1 means gradient bytes scale with nnz, not dim."""
+    metrics.inc_counter(f"collective.{op}.calls")
+    metrics.inc_counter(f"collective.{op}.bytes", int(nbytes))
+    if chunks > 1:
+        metrics.inc_counter(f"collective.{op}.chunks", int(chunks))
+    if dense_equiv_bytes:
+        metrics.inc_counter("collective.sparse.bytes", int(nbytes))
+        metrics.inc_counter(
+            "collective.sparse.dense_equiv_bytes", int(dense_equiv_bytes)
+        )
+        metrics.set_gauge(
+            "collective.sparse_ratio",
+            metrics.get_counter("collective.sparse.bytes")
+            / max(metrics.get_counter("collective.sparse.dense_equiv_bytes"), 1),
+        )
+    if _enabled:
+        attrs = dict(category="collective", bytes=int(nbytes), chunks=int(chunks), axis=axis)
+        if dense_equiv_bytes:
+            attrs["denseEquivBytes"] = int(dense_equiv_bytes)
+        event(f"collective.{op}", **attrs)
+
+
 def account_host_sync(kind: str = "drain", count: int = 1) -> None:
     """Fold one blocking host↔device synchronization point into the
     registry: a convergence-scalar drain, a packed fit-result readback, a

@@ -20,10 +20,18 @@ LossOut = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # (loss_sum, grad_sum, w
 
 
 class LossFunc(NamedTuple):
-    """A batched loss: name + callable(X, y, w, coeff) -> (loss_sum, grad_sum, weight_sum)."""
+    """A batched loss: name + callable(X, y, w, coeff) -> (loss_sum, grad_sum, weight_sum).
+
+    `pointwise(dot, y, w) -> (per-row loss, per-row multiplier)` is the
+    shared per-row form both layouts are built from; the overlap-scheduled
+    training path (parallel/overlap.py) uses it to compute per-shard local
+    loss pieces and defer the gradient reduction into the next epoch.
+    `sparse` marks the padded-CSR (indices, values) input layout."""
 
     name: str
     fn: Callable[..., LossOut]
+    pointwise: Callable = None
+    sparse: bool = False
 
     def __call__(self, X, y, w, coeff) -> LossOut:
         return self.fn(X, y, w, coeff)
@@ -95,16 +103,22 @@ def _sparse(pointwise):
     return fn
 
 
-BINARY_LOGISTIC_LOSS = LossFunc("binary_logistic", _dense(_logistic_pointwise))
-HINGE_LOSS = LossFunc("hinge", _dense(_hinge_pointwise))
-LEAST_SQUARE_LOSS = LossFunc("least_square", _dense(_least_square_pointwise))
+BINARY_LOGISTIC_LOSS = LossFunc(
+    "binary_logistic", _dense(_logistic_pointwise), _logistic_pointwise
+)
+HINGE_LOSS = LossFunc("hinge", _dense(_hinge_pointwise), _hinge_pointwise)
+LEAST_SQUARE_LOSS = LossFunc(
+    "least_square", _dense(_least_square_pointwise), _least_square_pointwise
+)
 
 SPARSE_BINARY_LOGISTIC_LOSS = LossFunc(
-    "sparse_binary_logistic", _sparse(_logistic_pointwise)
+    "sparse_binary_logistic", _sparse(_logistic_pointwise), _logistic_pointwise, True
 )
-SPARSE_HINGE_LOSS = LossFunc("sparse_hinge", _sparse(_hinge_pointwise))
+SPARSE_HINGE_LOSS = LossFunc(
+    "sparse_hinge", _sparse(_hinge_pointwise), _hinge_pointwise, True
+)
 SPARSE_LEAST_SQUARE_LOSS = LossFunc(
-    "sparse_least_square", _sparse(_least_square_pointwise)
+    "sparse_least_square", _sparse(_least_square_pointwise), _least_square_pointwise, True
 )
 
 SPARSE_VARIANTS = {

@@ -418,6 +418,25 @@ class SGD:
     (SURVEY.md §2.3: feature-sharded linear training as the TP analogue).
     The X@coeff contraction then all-reduces over `model` while the
     gradient contraction all-reduces over `data`; both ride ICI."""
+    collective_overlap: Optional[bool] = None
+    """Overlap-scheduled gradient reduction (parallel/overlap.py): the
+    epoch loop carries the unreduced per-shard gradient and defers its
+    bucketed all-reduce to the top of the next epoch, so batch b's
+    reduction overlaps batch b+1's staging — bit-identical coefficients by
+    construction. Sparse gradients additionally ride the SparCML
+    index-value reduction when below `config.collective_sparse_threshold`.
+    None follows the process-wide `config.collective_overlap`; applies to
+    the fused in-memory path (data-parallel, no checkpointing)."""
+
+    def _overlap_enabled(self) -> bool:
+        from .. import config
+
+        on = (
+            self.collective_overlap
+            if self.collective_overlap is not None
+            else config.collective_overlap
+        )
+        return bool(on) and not self.shard_features and self.checkpoint_dir is None
 
     def _hyper(self) -> np.ndarray:
         """The packed f32 hyper-parameter vector every kernel consumes —
@@ -476,6 +495,21 @@ class SGD:
         # the model length is the feature dim — X may be sparse (indices,
         # values), whose second axis is the nnz width, not the dim
         d = int(np.shape(init_coeff)[0])
+        if self._overlap_enabled():
+            from ..parallel import overlap
+
+            X_b, y_b, w_b = self._batchify(mesh, X, y, weights)
+            packed = overlap.overlapped_sgd_train(
+                mesh,
+                X_b,
+                y_b,
+                w_b,
+                jnp.asarray(np.asarray(init_coeff, self.dtype)),
+                loss_func,
+                self._hyper(),
+                validate_labels,
+            )
+            return ("packed", packed, d, validate_labels)
         if (
             not self.shard_features
             and self.checkpoint_dir is None

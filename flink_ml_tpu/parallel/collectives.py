@@ -5,9 +5,23 @@ The reference builds three comm primitives on Flink's netty shuffle
 (common/datastream/AllReduceImpl.java:56-103, 32KB chunks over two
 partitionCustom shuffles), broadcast variables (BroadcastUtils.java:64),
 and the statefun in-JVM feedback channel (operator/TailOperator.java:76-79).
-On TPU these are hardware collectives over ICI; this module is deliberately
-tiny — `psum` IS the all-reduce, replication IS the broadcast, and the
-feedback edge is a `lax.while_loop` carry (see parallel/iteration.py).
+On TPU these are hardware collectives over ICI; `psum` IS the all-reduce
+and replication IS the broadcast — but the reference's chunk decomposition
+is worth keeping: a large gradient reduced as one monolithic collective
+cannot overlap anything, while size-targeted buckets can pipeline against
+each other and against compute. This module therefore carries two tiers:
+
+- thin accounted wrappers over the hardware collectives (`all_reduce_sum`
+  … `ppermute_ring`) — every collective a model dispatches rides one of
+  these, so `collective.*` counters answer "what traffic does this program
+  move";
+- the comm layer proper: `all_reduce_sum_chunked` (bucketed
+  reduce_scatter+all_gather with a ring-pipelined ppermute variant) and
+  `sparse_all_reduce_sum` (SparCML-style index-value reduction, wire bytes
+  ∝ nnz instead of dim — arXiv:1802.08021). Both are bit-identical to a
+  single `lax.psum` of the same operand (pinned across chunk sizes and
+  shard counts by tests/test_collective_chunks.py); the overlap-scheduled
+  training loops in parallel/overlap.py are built on them.
 
 These wrappers are used inside `shard_map`-ped functions; outside
 `shard_map`, prefer sharding annotations and let XLA insert collectives.
@@ -15,7 +29,7 @@ These wrappers are used inside `shard_map`-ped functions; outside
 
 from __future__ import annotations
 
-from functools import partial
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,40 +42,71 @@ from ..utils import metrics
 from .mesh import DATA_AXIS
 
 
-def _account(op: str, x, axis_name: str) -> None:
+def _iter_array_leaves(x):
+    """Every array-like leaf of a possibly-nested structure. Unlike a bare
+    `tree_leaves` + hasattr filter, this also descends containers that are
+    not registered pytrees and never drops a level: a sparse (indices,
+    values) tuple nested inside a gradient pytree contributes BOTH leaves
+    to the byte count (the round-5 accounting undercounted these)."""
+    if isinstance(x, (tuple, list)):
+        for item in x:
+            yield from _iter_array_leaves(item)
+    elif isinstance(x, dict):
+        for item in x.values():
+            yield from _iter_array_leaves(item)
+    elif hasattr(x, "shape") and hasattr(x, "dtype"):
+        yield x
+    else:
+        try:
+            for leaf in jax.tree_util.tree_leaves(x):
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    yield leaf
+        except Exception:
+            pass
+
+
+def payload_bytes(x) -> int:
+    """Per-participant payload bytes of a pytree: the sum over every array
+    leaf, including leaves of nested non-pytree containers."""
+    return sum(
+        int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in _iter_array_leaves(x)
+    )
+
+
+def _account(op: str, x, axis_name: str, chunks: int = None, dense_equiv_bytes: int = None) -> None:
     """Record one collective call: op, per-participant payload bytes and
-    chunk (pytree-leaf) count. These wrappers run INSIDE jitted/shard_map
+    chunk (bucket/leaf) count. These wrappers run INSIDE jitted/shard_map
     code, so this fires at TRACE time — once per compiled program, not per
     execution — which is exactly when the op's shape is known; the
     counters answer "what collective traffic does this program dispatch",
     the device profile answers how long it took."""
-    try:
-        leaves = jax.tree_util.tree_leaves(x)
-        nbytes = sum(
-            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
-            for leaf in leaves
-            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
-        )
-    except Exception:
-        leaves, nbytes = [x], 0
-    metrics.inc_counter(f"collective.{op}.calls")
-    metrics.inc_counter(f"collective.{op}.bytes", nbytes)
-    if tracing.enabled():
-        tracing.event(
-            f"collective.{op}",
-            category="collective",
-            bytes=nbytes,
-            chunks=len(leaves),
-            axis=axis_name,
-        )
+    leaves = list(_iter_array_leaves(x))
+    nbytes = sum(int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize for leaf in leaves)
+    tracing.account_collective(
+        op,
+        nbytes,
+        chunks if chunks is not None else len(leaves),
+        axis_name,
+        dense_equiv_bytes=dense_equiv_bytes,
+    )
+
+
+def axis_size(axis_name: str = DATA_AXIS) -> int:
+    """Static participant count of a mapped axis, as a Python int (legal
+    only inside shard_map/pmap tracing). pre-graft jax lacks lax.axis_size;
+    psum of the constant 1 folds to the static size on both versions."""
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(axis_name))
+    return int(lax.psum(1, axis_name))
 
 
 def all_reduce_sum(x, axis_name: str = DATA_AXIS):
     """MPI-style all-reduce-sum: each participant gets the global sum.
 
-    Replaces DataStreamUtils.allReduceSum (AllReduceImpl.java:71): the
-    scatter-reduce/all-gather chunking the reference hand-rolls is what the
-    ICI hardware reduction does natively.
+    Replaces DataStreamUtils.allReduceSum (AllReduceImpl.java:71) as one
+    monolithic hardware collective; `all_reduce_sum_chunked` below is the
+    decomposed equivalent of the reference's 32KB chunk loop.
     """
     _account("psum", x, axis_name)
     return lax.psum(x, axis_name)
@@ -99,11 +144,195 @@ def ppermute_ring(x, axis_name: str = DATA_AXIS, shift: int = 1):
     """Ring shift along an axis — building block for ring pipelines
     (ring attention / pipelined all-reduce patterns)."""
     _account("ppermute", x, axis_name)
-    # pre-graft jax lacks lax.axis_size; psum of the constant 1 folds to the
-    # static axis size at trace time on both versions
-    n = lax.axis_size(axis_name) if hasattr(lax, "axis_size") else lax.psum(1, axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
+
+
+# ---------------------------------------------------------------------------
+# bucketed / ring-pipelined all-reduce (the chunked-AllReduceImpl analogue)
+# ---------------------------------------------------------------------------
+
+
+def _reduce_bucket_rs_ag(vec, axis_name: str, n: int):
+    """One bucket via reduce_scatter + all_gather — the bandwidth-optimal
+    decomposition (each element crosses each link ~2(n-1)/n times). The
+    bucket is zero-padded to an n-divisible length for the tiled scatter;
+    padding reduces to zero and is sliced off. Elementwise this computes
+    exactly what `psum` computes (same participant set, same per-element
+    reduction), so the result is bit-identical to the monolithic op."""
+    m = vec.shape[0]
+    pad = (-m) % n
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    shard = lax.psum_scatter(vec, axis_name, scatter_dimension=0, tiled=True)
+    out = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    return out[:m] if pad else out
+
+
+def _reduce_bucket_ring(vec, axis_name: str, n: int):
+    """One bucket via the ring pipeline: n-1 `ppermute` hops rotate every
+    shard's contribution around the ring, and each shard folds the arrivals
+    IN REPLICA ORDER (0..n-1 left-associated — the order the backend's own
+    all-reduce uses, so the fold stays bit-identical to `psum`; a classic
+    rotation-order ring reassociates the sum and is not). With several
+    buckets in flight, bucket i+1's hops are dataflow-independent of bucket
+    i's fold — the double-buffered schedule where chunk i+1's transfer
+    overlaps chunk i's compute (the async-collective pass materializes the
+    overlap on hardware)."""
+    idx = lax.axis_index(axis_name)
+    received = [vec]  # received[s] = contribution of replica (idx - s) mod n
+    cur = vec
+    for _ in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        received.append(cur)
+    stacked = jnp.stack(received)  # (n, m)
+    # contribution of replica r sits at arrival slot (idx - r) mod n
+    acc = stacked[jnp.mod(idx - 0, n)]
+    for r in range(1, n):
+        acc = acc + stacked[jnp.mod(idx - r, n)]
+    return acc
+
+
+def _bucket_sizes(total: int, itemsize: int, chunk_bytes) -> list:
+    """Split `total` elements into size-targeted bucket lengths."""
+    if not chunk_bytes or chunk_bytes <= 0:
+        return [total] if total else []
+    per = max(1, int(chunk_bytes) // max(1, itemsize))
+    sizes = []
+    off = 0
+    while off < total:
+        sizes.append(min(per, total - off))
+        off += sizes[-1]
+    return sizes
+
+
+def all_reduce_sum_chunked(
+    x,
+    axis_name: str = DATA_AXIS,
+    chunk_bytes: int = None,
+    ring: bool = None,
+):
+    """Bucketed all-reduce-sum of a pytree: bit-identical to `lax.psum(x)`.
+
+    The decomposition the reference hand-rolls at 32KB per chunk
+    (AllReduceImpl.java:56-103), rebuilt for ICI: leaves are grouped by
+    dtype, flattened, and split into `chunk_bytes`-targeted buckets
+    (config.collective_chunk_bytes when None, default 4MB); each bucket is
+    reduced independently — reduce_scatter+all_gather by default, or the
+    ring-pipelined ppermute fold with `ring=True`
+    (config.collective_ring when None). Because the per-element reduction
+    is unchanged, chunking changes *when bytes move*, never the result;
+    the parity suite pins bit-identity for chunk_bytes ∈ {1KB, 32KB, ∞}
+    on 1/2/8-shard meshes.
+    """
+    from .. import config
+
+    chunk_bytes = config.resolve_chunk_bytes(chunk_bytes)
+    if ring is None:
+        ring = config.collective_ring
+    n = axis_size(axis_name)
+
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    if not leaves:
+        return x
+    if n == 1:
+        _account("chunked", x, axis_name, chunks=len(leaves))
+        return x
+
+    # group leaves by dtype so buckets stay homogeneous
+    by_dtype: Dict[Any, list] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    reduce_bucket = _reduce_bucket_ring if ring else _reduce_bucket_rs_ag
+    out_leaves = list(leaves)
+    num_buckets = 0
+    for dtype, idxs in by_dtype.items():
+        flat = jnp.concatenate([jnp.ravel(leaves[i]) for i in idxs])
+        sizes = _bucket_sizes(flat.shape[0], dtype.itemsize, chunk_bytes)
+        num_buckets += len(sizes)
+        reduced, off = [], 0
+        for size in sizes:
+            reduced.append(reduce_bucket(flat[off : off + size], axis_name, n))
+            off += size
+        flat_red = reduced[0] if len(reduced) == 1 else jnp.concatenate(reduced)
+        off = 0
+        for i in idxs:
+            count = int(np.prod(leaves[i].shape))
+            out_leaves[i] = flat_red[off : off + count].reshape(leaves[i].shape)
+            off += count
+    _account("chunked", x, axis_name, chunks=num_buckets)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+# ---------------------------------------------------------------------------
+# sparse index-value all-reduce (SparCML, arXiv:1802.08021)
+# ---------------------------------------------------------------------------
+
+
+def sparse_all_reduce_sum(
+    indices,
+    values,
+    dim: int,
+    axis_name: str = DATA_AXIS,
+):
+    """All-reduce a gradient carried as per-shard (index, value) pairs;
+    returns the dense `(dim,)` sum, bit-identical to
+    `psum(zeros(dim).at[indices].add(values))` of the densified operand.
+
+    Wire bytes are the pairs, not the dim: each shard contributes its
+    `nnz_local * (4 + itemsize)` pair bytes to one all_gather, and the
+    dense vector never crosses a link — the SparCML index-value exchange
+    that makes sparseWideLR gradient traffic scale with nnz instead of
+    dim. The cross-shard combine scatters each shard's gathered pairs into
+    its own dense partial and folds the partials in replica order — the
+    exact association of the dense path (per-shard sequential scatter-add,
+    then replica-ordered psum), which is what makes the result bitwise
+    equal, not merely close.
+
+    Out-of-range / negative indices are dropped (`mode="drop"`), matching
+    the padded-CSR convention of ops/losses.py. Callers pick sparse vs
+    dense at trace time via `sparse_reduce_wins` below.
+    """
+    n = axis_size(axis_name)
+    indices = jnp.ravel(indices)
+    values = jnp.ravel(values)
+    itemsize = values.dtype.itemsize
+    _account(
+        "sparse_allreduce",
+        (indices, values),
+        axis_name,
+        chunks=1,
+        dense_equiv_bytes=int(dim) * itemsize,
+    )
+    if n == 1:
+        return jnp.zeros((dim,), values.dtype).at[indices].add(values, mode="drop")
+    gi = lax.all_gather(indices, axis_name, axis=0, tiled=False)  # (n, m)
+    gv = lax.all_gather(values, axis_name, axis=0, tiled=False)
+
+    def scatter_partial(r):
+        return jnp.zeros((dim,), values.dtype).at[gi[r]].add(gv[r], mode="drop")
+
+    acc = scatter_partial(0)
+    for r in range(1, n):
+        acc = acc + scatter_partial(r)
+    return acc
+
+
+def sparse_reduce_wins(
+    nnz_local: int, dim: int, itemsize: int = 4, threshold: float = None
+) -> bool:
+    """Trace-time decision: use the index-value reduction when its
+    per-shard pair bytes are at most `threshold` × the dense psum payload
+    (config.collective_sparse_threshold when None). Static shapes only —
+    the choice is baked into the compiled program."""
+    from .. import config
+
+    if threshold is None:
+        threshold = config.collective_sparse_threshold
+    pair_bytes = int(nnz_local) * (4 + int(itemsize))
+    return pair_bytes <= threshold * int(dim) * int(itemsize)
 
 
 def axis_index(axis_name: str = DATA_AXIS):
@@ -133,6 +362,27 @@ def shard_map_over(mesh: Mesh, in_specs, out_specs, fn=None, check_vma: bool = F
     return wrap(fn) if fn is not None else wrap
 
 
+# One jitted reducer per (mesh, stacked shape, dtype): defining the jit
+# inside host_all_reduce_sum built a fresh closure per call, so jax's
+# executable cache (keyed on function identity) missed every time and every
+# call RECOMPILED — ~10ms of XLA work per reduce on the host-driven loops.
+_HOST_REDUCE_CACHE: Dict[Tuple, Callable] = {}
+
+
+def _host_reduce_fn(mesh: Mesh, shape: Tuple[int, ...], dtype) -> Callable:
+    key = (mesh, tuple(shape), np.dtype(dtype).str)
+    fn = _HOST_REDUCE_CACHE.get(key)
+    if fn is None:
+        sharding = NamedSharding(mesh, P())
+
+        def _sum(stacked):
+            return jnp.sum(stacked, axis=0)
+
+        fn = jax.jit(_sum, out_shardings=sharding)
+        _HOST_REDUCE_CACHE[key] = fn
+    return fn
+
+
 def host_all_reduce_sum(mesh: Mesh, xs):
     """Sum per-shard host arrays into one replicated device array.
 
@@ -140,13 +390,9 @@ def host_all_reduce_sum(mesh: Mesh, xs):
     (the analogue of the reference's per-subtask accumulators funneled through
     countWindowAll, OnlineKMeans.java pattern); this reduces them with one
     device-side tree-sum and publishes the result replicated over `mesh`.
-    """
-    sharding = NamedSharding(mesh, P())
-
-    @partial(jax.jit, out_shardings=sharding)
-    def _sum(stacked):
-        return jnp.sum(stacked, axis=0)
-
+    The reducer is cached per (mesh, shape, dtype) — repeated reduces of the
+    same shape re-enter the same compiled executable (compile-count pinned
+    by tests/test_collective_chunks.py)."""
     # host-driven (not inside a trace): this span measures the real
     # per-call stack+upload+reduce wall time
     with tracing.span("collective.host_all_reduce_sum", category="collective") as sp:
@@ -158,4 +404,4 @@ def host_all_reduce_sum(mesh: Mesh, xs):
             "collective.host_all_reduce_sum.bytes",
             int(stacked.size * stacked.dtype.itemsize),
         )
-        return _sum(stacked)
+        return _host_reduce_fn(mesh, stacked.shape, stacked.dtype)(stacked)

@@ -1,0 +1,301 @@
+"""Comm/compute overlap scheduling for the iterative training loops.
+
+The default training programs let GSPMD place one monolithic all-reduce
+per batch at the point the gradient contraction completes: the reduction
+sits on the critical path between batch b's backward and batch b+1's
+forward, and nothing overlaps it. This module rebuilds the hot loops as
+explicit-SPMD (`shard_map`) programs with a **carry-delayed apply**: the
+loop carries the UNREDUCED per-shard gradient, and the reduction is
+deferred to the top of the next epoch — batch b's gradient buckets reduce
+(`collectives.all_reduce_sum_chunked`, ring-pipelined when configured)
+while batch b+1's batch slice/gather work is already in flight, and on
+hardware the async-collective pass hoists the bucket transfers under the
+forward compute. Snap ML (arXiv:1803.06333) motivates exactly this
+hierarchical chunk-and-overlap schedule.
+
+Bit-parity is by construction, the same way the dispatch pipeline pins
+chunked epochs (docs/performance.md §1): the reduction still happens
+before the apply that consumes it, the chunked/sparse reduction is
+bit-identical to the monolithic psum, and the per-epoch update order is
+unchanged — so overlap mode produces bit-identical coefficients, stop
+epochs, and criteria (pinned by tests/test_collective_chunks.py for dense
+and sparse losses, tol early-stop included).
+
+Sparse gradients additionally ride the SparCML index-value reduction
+(`collectives.sparse_all_reduce_sum`) when their per-shard pair bytes are
+below `config.collective_sparse_threshold` × the dense payload: the
+(indices, values) pairs of the batch cross the links instead of the
+densified `(dim,)` vector, so sparseWideLR gradient traffic scales with
+nnz, not dim.
+
+Gated by `config.collective_overlap` (see ops/optimizer.py and the KMeans
+driver); compiled programs are cached per (mesh, loss, flags) so repeated
+fits re-enter the same executable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import collectives
+from . import mesh as mesh_lib
+
+_SGD_CACHE: Dict[Tuple, Callable] = {}
+_LLOYD_CACHE: Dict[Tuple, Callable] = {}
+
+
+def clear_program_cache() -> None:
+    _SGD_CACHE.clear()
+    _LLOYD_CACHE.clear()
+
+
+def _config_key():
+    """The trace-relevant collective knobs; part of every program cache key
+    so flipping config recompiles instead of serving a stale schedule."""
+    from .. import config
+
+    return (
+        config.resolve_chunk_bytes(None),
+        bool(config.collective_ring),
+        float(config.collective_sparse_threshold),
+    )
+
+
+def _local_pieces(X, y, w, coeff, loss_func, sparse_pairs: bool):
+    """Per-shard loss pieces for one batch: (loss_sum_local, grad_local,
+    wsum_local). `grad_local` is either the dense per-shard scatter/matmul
+    partial — the exact local operand GSPMD would feed its psum — or, with
+    `sparse_pairs`, the flattened (indices, values) contribution pairs for
+    the index-value reduction."""
+    if loss_func.sparse:
+        from ..ops.losses import sparse_dot
+
+        indices, values = X
+        dot, safe, vals = sparse_dot(indices, values, coeff)
+        loss, mult = loss_func.pointwise(dot, y, w)
+        contrib = vals * mult[:, None]
+        if sparse_pairs:
+            grad_local = (jnp.ravel(safe), jnp.ravel(contrib))
+        else:
+            grad_local = (
+                jnp.zeros_like(coeff).at[safe].add(contrib, mode="drop")
+            )
+    else:
+        loss, mult = loss_func.pointwise(X @ coeff, y, w)
+        grad_local = X.T @ mult
+    return jnp.sum(loss), grad_local, jnp.sum(w)
+
+
+def _init_grad_local(coeff, num_rows: int, nnz: int, dtype, sparse_pairs: bool):
+    """Zero gradient carry matching `_local_pieces`' output structure; a
+    reduce of it is exactly the dense path's zero init gradient."""
+    if sparse_pairs:
+        return (
+            jnp.zeros((num_rows * nnz,), jnp.int32),
+            jnp.zeros((num_rows * nnz,), dtype),
+        )
+    return jnp.zeros_like(coeff)
+
+
+def sgd_use_sparse_pairs(X_b, d: int, mesh: Mesh) -> bool:
+    """Trace-time routing for the sparse SGD gradient: index-value pairs
+    when the mesh actually reduces (>1 data shard) and the per-shard pair
+    bytes beat the density threshold."""
+    if not isinstance(X_b, tuple):
+        return False
+    shards = mesh_lib.num_data_shards(mesh)
+    if shards <= 1:
+        return False
+    _, b_pad, nnz = X_b[0].shape
+    itemsize = np.dtype(X_b[1].dtype).itemsize
+    return collectives.sparse_reduce_wins(
+        (b_pad // shards) * nnz, d, itemsize=itemsize
+    )
+
+
+def overlapped_sgd_train(
+    mesh: Mesh,
+    X_b,
+    y_b,
+    w_b,
+    init_coeff,
+    loss_func,
+    hyper,
+    check_labels: bool,
+):
+    """The bounded SGD iteration as one explicit-SPMD program with
+    overlap-scheduled gradient reduction. Same contract as
+    `ops.optimizer._sgd_train`: returns the packed
+    [flag?, coeff, criteria, epochs] result vector.
+
+    Schedule per epoch (vs. the eager program's reduce-at-batch-end):
+
+        eager:    forward_b -> backward_b -> ALL-REDUCE -> apply -> fwd_{b+1}
+        overlap:  forward_b -> backward_b -> carry local grad
+                  ALL-REDUCE(grad_b) ∥ batch-slice/gather of b+1 -> apply -> fwd
+
+    The per-epoch tol check still needs the reduced loss, so the (loss,
+    wsum) SCALARS reduce every epoch (8 bytes — latency, not bandwidth);
+    only the dim-proportional gradient is deferred and bucketed."""
+    key = (
+        mesh,
+        loss_func,
+        bool(check_labels),
+        sgd_use_sparse_pairs(X_b, int(np.shape(init_coeff)[0]), mesh),
+        _config_key(),
+    )
+    fn = _SGD_CACHE.get(key)
+    if fn is None:
+        fn = _build_sgd_program(mesh, loss_func, key[2], key[3])
+        _SGD_CACHE[key] = fn
+    return fn(X_b, y_b, w_b, init_coeff, hyper)
+
+
+def _build_sgd_program(mesh: Mesh, loss_func, check_labels: bool, sparse_pairs: bool):
+    from ..ops.optimizer import (
+        _binomial_labels_ok,
+        _index_batch,
+        _pack_train_result,
+        _unpack_hyper,
+        _update_model,
+    )
+
+    axis = mesh_lib.DATA_AXIS
+    batched = P(None, axis, None)
+    x_spec = (batched, batched) if loss_func.sparse else batched
+    in_specs = (x_spec, P(None, axis), P(None, axis), P(), P())
+
+    def train(X_b, y_b, w_b, init_coeff, hyper):
+        num_batches, b_local = y_b.shape
+        d = init_coeff.shape[0]
+        dtype = X_b[1].dtype if isinstance(X_b, tuple) else X_b.dtype
+        nnz = X_b[0].shape[-1] if isinstance(X_b, tuple) else 0
+        max_iter, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+
+        def reduce_grad(g_local):
+            if sparse_pairs:
+                return collectives.sparse_all_reduce_sum(
+                    g_local[0], g_local[1], d, axis
+                )
+            return collectives.all_reduce_sum_chunked(g_local, axis)
+
+        def cond(state):
+            _, _, _, epoch, criteria = state
+            return jnp.logical_and(epoch < max_iter, criteria > tol)
+
+        def body(state):
+            coeff, g_local, wsum, epoch, _ = state
+            # carry-delayed apply: batch (epoch-1)'s gradient reduces here,
+            # where its buckets overlap this epoch's batch staging
+            coeff = _update_model(
+                coeff, reduce_grad(g_local), wsum, lr, reg, elastic_net
+            )
+            k = jnp.mod(epoch, num_batches)
+            Xk = _index_batch(X_b, k)
+            yk = lax.dynamic_index_in_dim(y_b, k, axis=0, keepdims=False)
+            wk = lax.dynamic_index_in_dim(w_b, k, axis=0, keepdims=False)
+            loss_local, g_local, wsum_local = _local_pieces(
+                Xk, yk, wk, coeff, loss_func, sparse_pairs
+            )
+            # the tol check needs the reduced criteria every epoch: reduce
+            # the two scalars now, leave the gradient in the carry
+            sums = collectives.all_reduce_sum(
+                jnp.stack([loss_local.astype(jnp.float32), wsum_local.astype(jnp.float32)]),
+                axis,
+            )
+            wsum = sums[1].astype(dtype)
+            criteria = sums[0] / jnp.maximum(sums[1], 1e-30)
+            return (coeff, g_local, wsum, epoch + 1, criteria)
+
+        init_state = (
+            jnp.asarray(init_coeff, dtype),
+            _init_grad_local(jnp.zeros((d,), dtype), b_local, nnz, dtype, sparse_pairs),
+            jnp.asarray(0.0, dtype),
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(jnp.inf, jnp.float32),
+        )
+        coeff, g_local, wsum, epochs, criteria = lax.while_loop(cond, body, init_state)
+        # the one-extra-update-after-termination of the reference
+        # (SGD.java onIterationTerminated) reduces the final carry
+        coeff = _update_model(coeff, reduce_grad(g_local), wsum, lr, reg, elastic_net)
+        flag = None
+        if check_labels:
+            ok = _binomial_labels_ok(y_b)
+            flag = collectives.all_reduce_min(ok, axis)
+        return _pack_train_result(coeff, criteria, epochs, flag)
+
+    mapped = collectives.shard_map_over(mesh, in_specs, P(), fn=train)
+    return jax.jit(mapped)
+
+
+def overlapped_lloyd_train(
+    mesh: Mesh, X, weights, init_centroids, max_iter, measure_name: str
+):
+    """Lloyd's loop with the same carry-delayed schedule: the (k, d)+(k,)
+    centroid-partial reduction of epoch e rides the chunked collective at
+    the top of epoch e+1, overlapping the pairwise-distance matmul of the
+    next assignment. Bit-identical to the eager `_lloyd_train` (the
+    reduce is psum-bit-equal and the update order is unchanged)."""
+    key = (mesh, measure_name, _config_key())
+    fn = _LLOYD_CACHE.get(key)
+    if fn is None:
+        fn = _build_lloyd_program(mesh, measure_name)
+        _LLOYD_CACHE[key] = fn
+    return fn(X, weights, init_centroids, max_iter)
+
+
+def _build_lloyd_program(mesh: Mesh, measure_name: str):
+    from ..ops.distance import DistanceMeasure
+
+    axis = mesh_lib.DATA_AXIS
+    measure = DistanceMeasure.get_instance(measure_name)
+
+    def train(X, weights, init_centroids, max_iter):
+        k = init_centroids.shape[0]
+
+        def reduce_partials(sums, counts):
+            return collectives.all_reduce_sum_chunked((sums, counts), axis)
+
+        def update(centroids, sums, counts):
+            return jnp.where(
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts[:, None], 1e-30),
+                centroids,
+            )
+
+        def cond(state):
+            return state[3] < max_iter
+
+        def step(state):
+            centroids, local_sums, local_counts, epoch = state
+            # epoch e-1's partials reduce here, overlapping this epoch's
+            # distance matmul on hardware; epoch 0 reduces the zero init
+            # (counts 0 -> centroids keep their init values, exactly the
+            # eager loop's first assignment)
+            sums, counts = reduce_partials(local_sums, local_counts)
+            centroids = update(centroids, sums, counts)
+            dists = measure.pairwise(X, centroids)
+            assign = jnp.argmin(dists, axis=1)
+            one_hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * weights[:, None]
+            return (centroids, one_hot.T @ X, jnp.sum(one_hot, axis=0), epoch + 1)
+
+        init = (
+            init_centroids,
+            jnp.zeros_like(init_centroids),
+            jnp.zeros((k,), X.dtype),
+            jnp.asarray(0, jnp.int32),
+        )
+        centroids, local_sums, local_counts, _ = lax.while_loop(cond, step, init)
+        sums, counts = reduce_partials(local_sums, local_counts)
+        return update(centroids, sums, counts), counts
+
+    mapped = collectives.shard_map_over(
+        mesh, (P(axis, None), P(axis), P(), P()), (P(), P()), fn=train
+    )
+    return jax.jit(mapped)

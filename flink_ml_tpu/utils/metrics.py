@@ -57,6 +57,10 @@ def inc_counter(name: str, delta: int = 1) -> None:
     _counters[name] = _counters.get(name, 0) + delta
 
 
+def get_counter(name: str, default: int = 0) -> int:
+    return _counters.get(name, default)
+
+
 def timer_totals() -> Dict[str, float]:
     """Total seconds per phase."""
     return {k: float(sum(v)) for k, v in _timers.items()}

@@ -88,7 +88,7 @@ class TestRunner:
         assert set(result) == {
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
-            "hostSyncCount", "dispatchDepth", "fusedSegments",
+            "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
         assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}

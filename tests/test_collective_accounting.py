@@ -1,0 +1,51 @@
+"""Tier-1 gate for scripts/check_collective_accounting.py: no raw lax
+collective call in models/ or ops/ may bypass the accounted wrappers in
+parallel/collectives.py — the `collective.*` counters (and the BENCH
+`collectiveBreakdown`) must stay an exhaustive traffic inventory."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_collective_accounting",
+        os.path.join(REPO, "scripts", "check_collective_accounting.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_no_raw_collectives_in_models_or_ops():
+    checker = _load_checker()
+    violations = checker.find_violations()
+    assert not violations, (
+        "raw lax collectives bypassing the accounted wrappers:\n"
+        + "\n".join(f"  {path}:{line}: lax.{prim}" for path, line, prim in violations)
+    )
+
+
+def test_gate_catches_a_planted_violation(tmp_path):
+    """The scanner itself works: a planted raw psum (outside a comment or
+    string) is reported; the same text inside a docstring is not."""
+    checker = _load_checker()
+    planted = tmp_path / "models"
+    planted.mkdir()
+    (planted / "bad.py").write_text(
+        '"""lax.psum(x, axis) in a docstring is fine."""\n'
+        "from jax import lax\n"
+        "# lax.psum(x) in a comment is fine\n"
+        "def f(x):\n"
+        "    return lax.psum(x, 'data')\n"
+    )
+    old_root, old_dirs = checker.ROOT, checker.SCANNED_DIRS
+    try:
+        checker.ROOT = str(tmp_path)
+        checker.SCANNED_DIRS = ("models",)
+        violations = checker.find_violations()
+    finally:
+        checker.ROOT, checker.SCANNED_DIRS = old_root, old_dirs
+    assert violations == [(os.path.join("models", "bad.py"), 5, "psum")]
