@@ -153,6 +153,15 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # segments the transform phase fused (0 = eager per-stage path); a
         # drop between BENCH files means stages fell off the fused path
         "fusedSegments": int(delta["gauges"].get("pipeline.fused_segments", 0)),
+        # input-pipeline evidence: bytes/transfers this entry pushed
+        # host→device through the accounted stager, and the device epoch
+        # cache's hit/miss split — an h2dBytes jump between BENCH files is
+        # an upload regression (a loop quietly going back to re-uploading
+        # its epochs), exactly as hostSyncCount is for readbacks
+        "h2dBytes": int(delta["counters"].get("h2d.bytes", 0)),
+        "h2dCount": int(delta["counters"].get("h2d.count", 0)),
+        "deviceCacheHits": int(delta["counters"].get("devicecache.hit", 0)),
+        "deviceCacheMisses": int(delta["counters"].get("devicecache.miss", 0)),
         # per-op collective traffic this entry traced (calls/bytes/chunks
         # from the accounted wrappers in parallel/collectives.py, plus the
         # sparse-vs-dense byte ratio when a sparse reduce ran) — the

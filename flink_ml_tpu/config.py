@@ -127,6 +127,62 @@ if os.environ.get("FLINK_ML_TPU_PIPELINE_FUSION") in ("auto", "off"):
     pipeline_fusion = os.environ["FLINK_ML_TPU_PIPELINE_FUSION"]
 
 
+# --- input pipeline: device epoch cache, prefetch, bucketing ------------------
+# (data/devicecache.py + parallel/prefetch.py)
+# HBM budget for the device-resident epoch cache fronting replayed stream
+# training (cache-once/replay-every-epoch, the ReplayOperator contract
+# lifted from host numpy into device memory): epoch 0 uploads each batch
+# once, epochs >= 1 read device-resident shards back with zero H2D bytes.
+# None = unbounded (cache everything), 0 = disabled (the eager re-upload
+# reference path); any budget computes bit-identical results — over-budget
+# batches are LRU-evicted back to the native host cache and re-staged
+# (accounted) on their next access.
+device_cache_bytes: Optional[int] = None
+# Max batches the input stager runs ahead of the consuming training loop:
+# one worker thread reads + packs + uploads batch b+1 while the device
+# computes batch b (parallel/prefetch.Prefetcher, data/devicecache.
+# CachedEpochLoader). Depth > 2 rarely helps — the worker is serial and
+# the device consumes one batch at a time.
+input_prefetch_depth: int = 2
+# Serving-style batch-shape bucketing on the stream-training staging paths
+# (pad to the next power-of-two row count by repeating the last row, mask
+# the pad with weight 0): free-running micro-batch sizes then hit a
+# bounded set of compiled programs instead of recompiling per shape.
+# Bit-exact by construction — a repeated row at weight 0 contributes +0.0
+# to every reduction. "off" is the exact-shape reference path.
+input_bucketing: bool = True
+
+
+@contextmanager
+def device_cache_budget(budget_bytes: Optional[int]):
+    """Scoped override of `device_cache_bytes` (None = unbounded, 0 = off)."""
+    global device_cache_bytes
+    prev = device_cache_bytes
+    device_cache_bytes = budget_bytes
+    try:
+        yield
+    finally:
+        device_cache_bytes = prev
+
+
+@contextmanager
+def input_bucketing_mode(enabled: bool = True):
+    """Scoped override of `input_bucketing`."""
+    global input_bucketing
+    prev = input_bucketing
+    input_bucketing = bool(enabled)
+    try:
+        yield
+    finally:
+        input_bucketing = prev
+
+
+if os.environ.get("FLINK_ML_TPU_DEVICE_CACHE_BYTES"):
+    device_cache_bytes = int(os.environ["FLINK_ML_TPU_DEVICE_CACHE_BYTES"])
+if os.environ.get("FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"):
+    input_prefetch_depth = int(os.environ["FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"])
+
+
 # --- persistent XLA compilation cache ----------------------------------------
 # Cold-start killer: compiled executables survive process restarts, so the
 # first fit of a new process reuses the previous process's XLA programs

@@ -1,0 +1,6 @@
+"""Data-plane infrastructure: device-resident epoch caching for bounded
+iterations over cached streams (`devicecache`). The host-side spillable
+segment store lives in `flink_ml_tpu.native.datacache`; this package holds
+the HBM tier stacked on top of it."""
+
+from .devicecache import CachedEpochLoader, DeviceEpochCache  # noqa: F401

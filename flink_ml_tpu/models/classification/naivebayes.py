@@ -255,8 +255,10 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
                             self.labels.astype(np.float32),
                         ]
                     )
+                    from ...parallel.prefetch import stage_to_device
+
                     dev = self._device_tensors = (
-                        *_nb_unpack_model(jax.device_put(flat), dm, m_max, L),
+                        *_nb_unpack_model(stage_to_device(flat), dm, m_max, L),
                         m_max,
                     )
         if dev:

@@ -229,11 +229,16 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
                 alpha, beta, l1, l2,
             )
 
+        from ...parallel import prefetch as h2d
         from ...parallel.iteration import checkpoint_job_key
 
         init = (coeff, np.zeros(d), np.zeros(d))
+        # shared input stager: the (X, y) upload of global batch b+1 runs
+        # on the worker thread (accounted, h2d.*) while batch b's FTRL
+        # step executes — micro-batch H2D off the critical path
+        staged = h2d.Prefetcher(h2d.stage_to_device).iterate(rebatch(stream))
         raw_updates = iterate_unbounded(
-            rebatch(stream), step, init, job_key=checkpoint_job_key(self)
+            staged, step, init, job_key=checkpoint_job_key(self)
         )
         updates = ((version, state[0]) for version, state in raw_updates)
         model = OnlineLogisticRegressionModel()

@@ -19,6 +19,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...api import Estimator, Model
@@ -32,6 +33,7 @@ from ...common.param import (
 from ...ops.distance import DistanceMeasure, jit_find_closest
 from ...param import IntParam, ParamValidators, StringParam
 from ...parallel import mesh as mesh_lib
+from ...parallel import prefetch as h2d
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
 from ...utils.param_utils import update_existing_params
@@ -214,6 +216,16 @@ def _stage_points(X, n_pad, sharding):
     return jax.lax.with_sharding_constraint(X, sharding)
 
 
+@partial(jax.jit, static_argnames=("d", "mat_sharding", "row_sharding"))
+def _unpack_points(packed, d, mat_sharding, row_sharding):
+    """Split the dtype-packed [X | w] stream batch on device, constrained
+    to the accumulation shardings — the single-transfer layout the stream
+    staging path uploads (see ops/optimizer._unpack_stream_batch)."""
+    X = lax.with_sharding_constraint(packed[:, :d], mat_sharding)
+    w = lax.with_sharding_constraint(packed[:, d], row_sharding)
+    return X, w
+
+
 @partial(jax.jit, static_argnames=("n_pad", "sharding"))
 def _unit_weights(n, n_pad, sharding):
     # n is a traced operand: one compiled program per n_pad, not per (n, n_pad)
@@ -251,7 +263,7 @@ class KMeans(Estimator, KMeansParams):
             X_host = np.asarray(X, dtype=np.float32)
             init_centroids = jnp.asarray(X_host[centroid_idx])
             X_pad, _ = mesh_lib.pad_to_multiple(X_host, shards)
-            X_dev = jax.device_put(X_pad, mat_sharding)
+            X_dev = h2d.stage_to_device(X_pad, mat_sharding)
         w_dev = _unit_weights(n, n_pad, row_sharding)
 
         from ...obs import tracing
@@ -307,8 +319,11 @@ class KMeans(Estimator, KMeansParams):
     def _fit_stream(self, stream) -> KMeansModel:
         """Out-of-core Lloyd over a StreamTable: the first pass caches every
         batch through the native spillable data cache (cache-then-replay,
-        ReplayOperator.java:125-246), later epochs replay the cached stream
-        with only one batch in HBM at a time. Initialization matches the
+        ReplayOperator.java:125-246); epoch 0 stages each batch to device
+        once and later epochs replay the device-resident shards through
+        the HBM epoch cache (zero H2D bytes within
+        `config.device_cache_bytes`; over-budget batches re-stage from the
+        host cache, one in flight at a time). Initialization matches the
         in-memory path exactly: the same seeded global-row-index sample
         (selectRandomCentroids, KMeans.java:310) fetched back from the
         cache, so a stream fit reproduces an in-memory fit of the
@@ -357,50 +372,58 @@ class KMeans(Estimator, KMeansParams):
         row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         centroids = jnp.asarray(init)
         measure = self.get_distance_measure()
+        d = init.shape[1]
+        nb = len(batch_rows)
 
-        # Single-worker prefetch (native cache access stays serial, like
-        # the SGD stream loop): the worker reads + pads + uploads batch
-        # i+1 while the device accumulates batch i, so cache/disk reads
-        # and H2D transfers ride under the assignment contractions — the
-        # overlap DataCacheReader gets from Flink's async mailbox.
-        from concurrent.futures import ThreadPoolExecutor
+        # Input pipeline (data/devicecache.py + parallel/prefetch.py):
+        # epoch 0 stages each cached batch ONCE — bucketed to a
+        # recompile-bounding row count (repeat-last-row pad at weight 0,
+        # bit-invisible to the segment sums) and uploaded as a single
+        # dtype-packed [X | w] transfer straight into the data-parallel
+        # sharded layout — and later epochs iterate the device-resident
+        # shards with zero H2D bytes inside `config.device_cache_bytes`.
+        # Misses re-stage through the shared single-worker prefetcher, so
+        # cache/disk reads and uploads of batch i+1 ride under batch i's
+        # assignment contractions (native cache access stays serial).
+        from ... import config
+        from ...data.devicecache import CachedEpochLoader
 
-        def fetch(it):
-            t = next(it, None)
-            if t is None:
-                return None
+        replay_pos = {"it": None, "pos": 0}
+
+        def stage(bi):
+            # batches replay strictly in order within an epoch, so the
+            # worker walks one shared iterator, skipping cache-hit batches
+            if replay_pos["it"] is None or bi < replay_pos["pos"]:
+                replay_pos["it"], replay_pos["pos"] = iter(replay), 0
+            t = None
+            while replay_pos["pos"] <= bi:
+                t = next(replay_pos["it"])
+                replay_pos["pos"] += 1
             X = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
             rows = X.shape[0]
-            X_pad, _ = mesh_lib.pad_to_multiple(X, shards)
-            w = np.zeros(X_pad.shape[0], np.float32)
-            w[:rows] = 1.0
-            return (
-                jax.device_put(X_pad, mat_sharding),
-                jax.device_put(w, row_sharding),
-            )
+            bucket = h2d.next_bucket(rows) if config.input_bucketing else rows
+            target = -(-bucket // shards) * shards
+            packed = np.empty((target, d + 1), np.float32)
+            packed[:rows, :d] = X
+            packed[rows:, :d] = X[rows - 1 : rows]  # repeat-last-row pad
+            packed[:rows, d] = 1.0
+            packed[rows:, d] = 0.0  # weight-0: the pad is compute-invisible
+            packed_dev = h2d.stage_to_device(packed, mat_sharding)
+            return _unpack_points(packed_dev, d, mat_sharding, row_sharding)
 
-        executor = ThreadPoolExecutor(max_workers=1)
-        try:
-            for _ in range(self.get_max_iter()):
-                sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
-                counts = jnp.zeros((k,), jnp.float32)
-                it = iter(replay)
-                fut = executor.submit(fetch, it)
-                while True:
-                    batch = fut.result()
-                    if batch is None:
-                        break
-                    fut = executor.submit(fetch, it)
-                    s, c = _accumulate_batch(*batch, centroids, measure)
-                    sums = sums + s
-                    counts = counts + c
-                centroids = jnp.where(
-                    counts[:, None] > 0,
-                    sums / jnp.maximum(counts[:, None], 1e-30),
-                    centroids,
-                )
-        finally:
-            executor.shutdown(wait=True, cancel_futures=True)
+        loader = CachedEpochLoader(stage)
+        for _ in range(self.get_max_iter()):
+            sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
+            counts = jnp.zeros((k,), jnp.float32)
+            for batch in loader.epoch(range(nb)):
+                s, c = _accumulate_batch(*batch, centroids, measure)
+                sums = sums + s
+                counts = counts + c
+            centroids = jnp.where(
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts[:, None], 1e-30),
+                centroids,
+            )
 
         from ...utils.packing import packed_device_get
 

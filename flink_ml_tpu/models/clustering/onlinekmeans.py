@@ -31,6 +31,7 @@ from ...common.param import (
     HasSeed,
 )
 from ...ops.distance import DistanceMeasure, jit_find_closest
+from ...parallel import prefetch as h2d
 from ...parallel.iteration import iterate_unbounded
 from ...table import StreamTable, Table, as_dense_matrix
 from ...utils import read_write
@@ -146,13 +147,25 @@ class OnlineKMeansModel(Model, KMeansModelParams):
         return self.model_version
 
     def transform(self, *inputs: Table) -> List[Table]:
+        from ... import config
+
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()))
+        n = X.shape[0]
+        if config.input_bucketing:
+            # serving-style shape bucketing: free-running online predict
+            # batches pad to the power-of-two schedule (repeat-last-row —
+            # real data, guard-safe) so the assignment kernel compiles
+            # once per bucket, not once per incoming batch shape; the pad
+            # is sliced back off below
+            X = h2d.pad_rows(X, n, h2d.next_bucket(n))
         assign = jit_find_closest(self.get_distance_measure())(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
         )
         return [
-            table.with_column(self.get_prediction_col(), np.asarray(assign, dtype=np.int32))
+            table.with_column(
+                self.get_prediction_col(), np.asarray(assign[:n], dtype=np.int32)
+            )
         ]
 
     def _save_extra(self, path: str) -> None:
@@ -217,8 +230,12 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
 
         from ...parallel.iteration import checkpoint_job_key
 
+        # shared input stager: one worker thread uploads global batch b+1
+        # (accounted, h2d.*) while batch b's update step runs — the
+        # micro-batch H2D leaves the critical path between steps
+        staged = h2d.Prefetcher(h2d.stage_to_device).iterate(rebatch(stream))
         updates = iterate_unbounded(
-            rebatch(stream),
+            staged,
             step,
             (centroids, weights),
             job_key=checkpoint_job_key(self),

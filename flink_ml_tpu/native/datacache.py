@@ -89,7 +89,13 @@ class DataCache:
             metrics.inc_counter("datacache.readBytes", int(size))
             return out.view(dtype).reshape(shape)
         metrics.inc_counter("datacache.readBytes", len(self._segments[seg]))
-        return np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape)
+        # frombuffer over the stored bytes is a READ-ONLY view; consumers
+        # that mutate in place (scalers normalizing a replayed batch,
+        # np.pad-free padding) would crash on it — copy to a writable
+        # array, matching the native path's np.empty-backed reads
+        return (
+            np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape).copy()
+        )
 
     @property
     def num_segments(self) -> int:
@@ -113,6 +119,16 @@ class DataCache:
         if self._handle is not None:
             self._lib.dc_destroy(self._handle)
             self._handle = None
+        # dc_destroy removes the spill file it opened, but a cache whose
+        # native side failed mid-stream (or an older library build) can
+        # leave the segment store behind — a GB-class stale file per
+        # training job in the spill dir. Idempotent host-side cleanup.
+        path = getattr(self, "_spill_path", None)
+        if path is not None and os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def __del__(self):  # noqa: D105
         try:

@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..parallel import prefetch as h2d
 from .losses import LossFunc
 
 
@@ -138,6 +139,15 @@ def _update_model(coeff, grad, wsum, lr, reg, elastic_net):
         return c
 
     return lax.cond(wsum > 0, do_update, lambda c: c, coeff)
+
+
+# Jitted entry for the host-driven tails (stream + checkpointed loops):
+# called eagerly, the lax.cond closes over that fit's gradient VALUES as
+# constants and XLA compiles a fresh program per fit — one stray compile
+# per stream fit on the jit.compiles counter. As a jitted function all
+# operands are runtime arguments, so every fit at a given model shape
+# re-enters one executable.
+_final_update = jax.jit(_update_model)
 
 
 def _binomial_labels_ok(y):
@@ -324,6 +334,19 @@ _stream_epoch = jax.jit(_stream_epoch_impl, static_argnames=("loss_func",))
 _stream_epoch_donating = jax.jit(
     _stream_epoch_impl, static_argnames=("loss_func",), donate_argnums=(3, 4)
 )
+
+
+@partial(jax.jit, static_argnames=("d", "mat_sharding", "row_sharding"))
+def _unpack_stream_batch(packed, d, mat_sharding, row_sharding):
+    """Split the dtype-packed [X | y | w] stream batch back into its parts
+    ON DEVICE, constrained to the training shardings. The pack exists so a
+    cached stream batch crosses the tunnel as ONE host→device transfer
+    (three separate uploads each paid their own dispatch); slicing columns
+    out of the uploaded buffer moves no bytes and is bit-exact."""
+    X = lax.with_sharding_constraint(packed[:, :d], mat_sharding)
+    y = lax.with_sharding_constraint(packed[:, d], row_sharding)
+    w = lax.with_sharding_constraint(packed[:, d + 1], row_sharding)
+    return X, y, w
 
 
 def _sgd_chunk_impl(X_b, y_b, w_b, carry, criteria, loss_func, hyper, chunk_end):
@@ -531,7 +554,7 @@ class SGD:
         X_b, y_b, w_b = self._batchify(mesh, X, y, weights, d_pad)
         init = np.asarray(init_coeff, self.dtype)
         if self.shard_features:
-            init = jax.device_put(init, mesh_lib.model_sharding(mesh))
+            init = h2d.stage_to_device(init, mesh_lib.model_sharding(mesh))
         if self.checkpoint_dir is not None:
             coeff, criteria, epochs = self._optimize_with_checkpoints(
                 X_b, y_b, w_b, init, loss_func
@@ -567,10 +590,17 @@ class SGD:
         (flink-ml-iteration/.../operator/ReplayOperator.java:125-246) +
         spillable DataCache (datacache/nonkeyed/DataCacheWriter.java): the
         single pass over the stream re-chunks rows into globalBatchSize
-        batches and appends them to the native spillable cache; every epoch
-        then replays its batch from the cache. Only one batch is resident
-        in HBM at a time, so datasets larger than device memory (and, with
-        spill, larger than host memory budget) train fine.
+        batches, packs each as ONE [X | y | w] segment, and appends it to
+        the native spillable cache; every epoch then replays its batch
+        from the cache THROUGH the device epoch cache
+        (data/devicecache.py): within `config.device_cache_bytes` a batch
+        uploads once — a single dtype-packed transfer straight into its
+        data-parallel sharded layout — and later epochs read the
+        device-resident shards back with zero H2D bytes. Over-budget
+        batches stay in the host cache and re-stage on access (budget 0 =
+        the eager re-upload path; any budget is bit-identical), so
+        datasets larger than device memory (and, with spill, larger than
+        the host memory budget) train fine.
 
         Batch schedule and padding match `optimize` exactly, so a stream
         fit produces the same coefficients as an in-memory fit of the
@@ -594,24 +624,21 @@ class SGD:
             else config.datacache_memory_budget_bytes,
             spill_dir if spill_dir is not None else config.datacache_spill_dir,
         )
-        segs = []  # per batch: (seg_X, seg_y, seg_w)
+        segs = []  # per batch: one packed [X | y | w] segment id
         pend = None  # carried remainder rows (X, y, w)
         d = None
 
         def emit(Xb, yb, wb):
-            """Pad a B-row batch to b_pad with weight-0 rows and cache it."""
+            """Pad a B-row batch to b_pad with weight-0 rows and cache it
+            as ONE packed (b_pad, d+2) segment — the layout the staging
+            path uploads in a single transfer (`_unpack_stream_batch`)."""
             if b_pad != Xb.shape[0]:
                 extra = b_pad - Xb.shape[0]
                 Xb = np.pad(Xb, [(0, extra), (0, 0)])
                 yb = np.pad(yb, (0, extra))
                 wb = np.pad(wb, (0, extra))
-            segs.append(
-                (
-                    cache.append_array(Xb),
-                    cache.append_array(yb),
-                    cache.append_array(wb),
-                )
-            )
+            packed = np.concatenate([Xb, yb[:, None], wb[:, None]], axis=1)
+            segs.append(cache.append_array(np.ascontiguousarray(packed)))
 
         for chunk in chunks:
             X, y, w = chunk
@@ -667,31 +694,28 @@ class SGD:
                 carry, epoch, criteria = restored
                 carry = tuple(jnp.asarray(leaf) for leaf in carry)
         nb = len(segs)
-        last_k, batch_dev = None, None
 
-        # Double-buffered prefetch: a single worker thread owns every cache
-        # read + device_put (native cache access stays serial), staging batch
-        # (epoch+1) % nb while the device runs the current epoch — the
-        # overlap the reference gets from DataCacheReader on Flink's async
-        # mailbox. nb == 1 keeps the single upfront upload. On top of that,
-        # the convergence scalar is drained through a bounded-depth queue
-        # instead of a per-epoch float() sync: dispatched epochs past the
-        # tol-fire point are criteria-guarded identity programs, so the
-        # stop epoch and coefficients are exact (see _stream_epoch_impl).
-        from concurrent.futures import ThreadPoolExecutor
-
+        # Input pipeline (data/devicecache.py + parallel/prefetch.py): the
+        # device epoch cache serves replayed batches straight from HBM
+        # (epoch 0 uploads each batch once, later epochs move zero H2D
+        # bytes within budget), and misses are staged by the shared
+        # single-worker prefetcher — cache read + pack-upload of batch
+        # b+1 ride under batch b's compute (native cache access stays
+        # serial; the overlap the reference gets from DataCacheReader on
+        # Flink's async mailbox). On top of that, the convergence scalar
+        # is drained through a bounded-depth queue instead of a per-epoch
+        # float() sync: dispatched epochs past the tol-fire point are
+        # criteria-guarded identity programs, so the stop epoch and
+        # coefficients are exact (see _stream_epoch_impl).
         from .. import config
+        from ..data.devicecache import CachedEpochLoader
         from ..obs import tracing
         from ..parallel import dispatch
         from ..utils.packing import packed_device_get
 
         def fetch(k):
-            sX, sy, sw = segs[k]
-            return (
-                jax.device_put(cache.read_array(sX), mat_sharding),
-                jax.device_put(cache.read_array(sy), row_sharding),
-                jax.device_put(cache.read_array(sw), row_sharding),
-            )
+            packed_dev = h2d.stage_to_device(cache.read_array(segs[k]), mat_sharding)
+            return _unpack_stream_batch(packed_dev, d, mat_sharding, row_sharding)
 
         interval = max(1, int(self.checkpoint_interval))
         donate_ok = dispatch.supports_donation()
@@ -720,19 +744,14 @@ class SGD:
                 if crit <= self.tol:
                     stopped = True
 
-        executor = ThreadPoolExecutor(max_workers=1)
-        fut = executor.submit(fetch, epoch % nb)
+        loader = CachedEpochLoader(fetch)
+        batch_iter = loader.epoch(p % nb for p in range(epoch, self.max_iter))
         try:
             planned = epoch
             donate_next = False
             while planned < self.max_iter and not stopped:
                 with tracing.span("iteration.epoch", epoch=planned, mode="stream"):
-                    k = planned % nb
-                    if k != last_k:  # nb == 1 reads/uploads the batch only once
-                        batch_dev = fut.result()
-                        last_k = k
-                        if nb > 1:
-                            fut = executor.submit(fetch, (planned + 1) % nb)
+                    batch_dev = next(batch_iter)
                     retain = (
                         self.checkpoint_dir is not None
                         and (planned + 1) % interval == 0
@@ -756,7 +775,7 @@ class SGD:
                 donate_next = not retain
             handle(queue.drain_all())
             coeff, grad, wsum, _ = carry
-            coeff = _update_model(
+            coeff = _final_update(
                 coeff, grad, wsum,
                 jnp.asarray(self.learning_rate, self.dtype),
                 jnp.asarray(self.reg, self.dtype),
@@ -767,9 +786,10 @@ class SGD:
                 "numSegments": cache.num_segments,
                 "spilledSegments": cache.spilled_segments,
                 "memoryUsedBytes": cache.memory_used,
+                "deviceCache": loader.cache.stats,
             }
         finally:
-            executor.shutdown(wait=True, cancel_futures=True)
+            batch_iter.close()  # cancels speculative staging, stops the worker
             cache.close()
         return np.asarray(coeff_h), final_crit, final_epoch, stats
 
@@ -792,7 +812,7 @@ class SGD:
             if isinstance(arr, jax.Array):
                 return arr.astype(dtype) if arr.dtype != dtype else arr
             arr = np.asarray(arr)
-            return jax.device_put(
+            return h2d.stage_to_device(
                 arr.astype(dtype) if arr.dtype != dtype else arr,
                 mesh_lib.data_sharding(mesh, arr.ndim),
             )
@@ -925,7 +945,7 @@ class SGD:
 
         coeff, grad, wsum, _ = carry
         dtype = _feature_dtype(X_b)
-        coeff = _update_model(
+        coeff = _final_update(
             coeff, grad, wsum,
             jnp.asarray(self.learning_rate, dtype),
             jnp.asarray(self.reg, dtype),
@@ -968,7 +988,7 @@ class SGD:
             sharding = NamedSharding(mesh, spec)
             rows = arr.shape[0]
             if shards == 1 or rows % shards == 0:
-                return jax.device_put(arr, sharding), True
+                return h2d.stage_to_device(arr, sharding), True
             n_stage = -(-rows // shards) * shards
 
             def shard_chunk(index):
@@ -984,7 +1004,7 @@ class SGD:
                 return chunk[(slice(None),) + tuple(index[1:])]
 
             return (
-                jax.make_array_from_callback(
+                h2d.stage_from_callback(
                     (n_stage,) + arr.shape[1:], sharding, shard_chunk
                 ),
                 True,

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..parallel.prefetch import stage_to_device
+
 
 def _count_dtype():
     """tf/df accumulator dtype: int64 when x64 is enabled (exact past 2^31
@@ -265,14 +267,14 @@ def map_term_runs_chunked(
     if lut_host is not None and dense:
         pre = lut_preimage(lut_host, int(num_terms))
         if pre is not None:
-            pre = jax.device_put(pre)
+            pre = stage_to_device(pre)
     small_dict = (
         pre is None
         and lut_host is not None
         and lut_host.shape[0] <= MAP_COMPARE_MAX_DICT
     )
     if lut_host is not None:
-        lut = jax.device_put(lut_host.astype(np.int32, copy=False))
+        lut = stage_to_device(lut_host.astype(np.int32, copy=False))
 
     def run_chunk(chunk_ids, chunk_thr):
         if pre is not None:
@@ -361,12 +363,12 @@ def filter_tokens_chunked(ids, keep_vocab, chunk_rows: int = CHUNK_ROWS):
         if drop.size <= MAP_COMPARE_MAX_DICT:
             if drop.size == 0:
                 return ids if hasattr(ids, "devices") else jnp.asarray(ids)
-            drop_dev = jax.device_put(drop)
+            drop_dev = stage_to_device(drop)
             V = int(keep_host.shape[0])
             kernel = lambda c: filter_tokens_dropset(c, drop_dev, V)  # noqa: E731
     if kernel is None:
         if keep_host is not None:
-            keep_vocab = jax.device_put(keep_host)
+            keep_vocab = stage_to_device(keep_host)
         kernel = lambda c: filter_tokens(c, keep_vocab)  # noqa: E731
     if n <= chunk_rows:
         return kernel(ids)

@@ -1,0 +1,210 @@
+"""Input staging — accounted H2D uploads, shape bucketing, async prefetch.
+
+The round-5 story for the *output* side of the tunnel (every readback is a
+counted `readback.*` event riding the packed funnels) applied to the
+*input* side. Three pieces, shared by every training loop and the serving
+runner:
+
+1. **Accounted staging** — `stage_to_device` / `stage_from_callback` are
+   the ONLY sanctioned host→device transfer calls in `models/` and `ops/`
+   (`scripts/check_upload_accounting.py` fails the build on a raw
+   `jax.device_put` there, the mirror of the collective-accounting gate).
+   Every upload increments `h2d.bytes` / `h2d.count`, so the BENCH
+   metrics delta answers "how many bytes crossed the tunnel host→device"
+   as exhaustively as it answers the readback question. Device→device
+   re-placements transfer nothing and are not counted.
+
+2. **Batch-shape bucketing** — `next_bucket` / `pad_rows`, the serving
+   runner's recompile-bounding shape schedule (powers of two, pad =
+   repeat the last REAL row — guard-safe by construction) promoted to a
+   shared helper so the stream-training staging paths use the identical
+   policy. Training paths pair the padding with weight-0 masking, which
+   keeps bucketing bit-exact: a repeated row at weight 0 contributes
+   +0.0 to every loss/gradient/count reduction.
+
+3. **Double-buffered prefetch** — `Prefetcher` runs a caller-supplied
+   `stage` function in ONE worker thread, up to `config.
+   input_prefetch_depth` items ahead of consumption, yielding results in
+   input order (a single worker keeps native-cache access serial, the
+   constraint the hand-rolled loops in `ops/optimizer.py` and the KMeans
+   stream fit enforced separately before this module replaced them).
+   Batch b+1's cache read + pack + H2D upload ride under batch b's
+   compute — the overlap the reference gets from DataCacheReader on
+   Flink's async mailbox.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..utils import metrics
+
+__all__ = [
+    "stage_to_device",
+    "stage_from_callback",
+    "next_bucket",
+    "pad_rows",
+    "slice_rows",
+    "Prefetcher",
+]
+
+
+# ---------------------------------------------------------------------------
+# accounted H2D staging
+# ---------------------------------------------------------------------------
+
+def _host_nbytes(tree) -> int:
+    """Bytes that will actually cross host→device: numpy leaves only —
+    already-device-resident (jax) leaves re-place without a host upload."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, np.ndarray):
+            total += leaf.nbytes
+        elif not isinstance(leaf, jax.Array) and hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+def account_h2d(nbytes: int, arrays: int = 1) -> None:
+    """Fold one host→device transfer into the registry — the upload-side
+    sibling of `obs.tracing.account_readback`."""
+    metrics.inc_counter("h2d.count", arrays)
+    metrics.inc_counter("h2d.bytes", int(nbytes))
+
+
+def stage_to_device(tree, sharding=None):
+    """Accounted `jax.device_put`: upload a host array (or pytree of
+    arrays; dtypes canonicalize exactly as `device_put` does) and count
+    the host bytes moved. The one H2D funnel `models/` and `ops/` are
+    allowed to call (see `scripts/check_upload_accounting.py`)."""
+    import jax
+
+    nbytes = _host_nbytes(tree)
+    if nbytes:
+        account_h2d(nbytes)
+    if sharding is not None:
+        return jax.device_put(tree, sharding)
+    return jax.device_put(tree)
+
+
+def stage_from_callback(shape, sharding, data_callback):
+    """Accounted `jax.make_array_from_callback` (the per-shard zero-copy
+    staging path of `_batchify`); bytes are counted from the staged
+    array's own dtype, so callers need not precompute it."""
+    import jax
+
+    out = jax.make_array_from_callback(tuple(shape), sharding, data_callback)
+    account_h2d(int(np.prod(shape)) * out.dtype.itemsize)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batch-shape bucketing (shared with serving.MicroBatchServer)
+# ---------------------------------------------------------------------------
+
+def next_bucket(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest bucket >= n. Default schedule: powers of two (>= 8), the
+    classic recompile-bounding shape schedule; an explicit sorted bucket
+    list wins when the traffic distribution is known."""
+    if n <= 0:
+        return n  # empty batch: nothing to pad
+    if buckets:
+        for b in buckets:
+            if b >= n:
+                return int(b)
+        return int(n)  # beyond the largest bucket: exact shape
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_rows(col, n: int, bucket: int):
+    """Pad a column from n to bucket rows by repeating its final row (a
+    real row: guard-safe — a copy of real data can never fire a
+    validation guard the real data would not). Works for host numpy,
+    device arrays and SparseBatch; training callers mask the padding
+    with weight 0, which keeps the pad bit-invisible to every reduction."""
+    if bucket == n:
+        return col
+    from ..table import SparseBatch
+
+    if isinstance(col, SparseBatch):
+        return SparseBatch(
+            col.size,
+            pad_rows(col.indices, n, bucket),
+            pad_rows(col.values, n, bucket),
+        )
+    try:
+        import jax
+
+        if isinstance(col, jax.Array):
+            import jax.numpy as jnp
+
+            reps = jnp.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
+            return jnp.concatenate([col, reps])
+    except ImportError:  # pragma: no cover
+        pass
+    col = np.asarray(col)
+    reps = np.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
+    return np.concatenate([col, reps])
+
+
+def slice_rows(col, n: int):
+    """Undo `pad_rows` on an output column (device slice, no host pull)."""
+    from ..table import SparseBatch
+
+    if isinstance(col, SparseBatch):
+        return SparseBatch(col.size, col.indices[:n], col.values[:n])
+    return col[:n]
+
+
+# ---------------------------------------------------------------------------
+# bounded-depth single-worker prefetch
+# ---------------------------------------------------------------------------
+
+class Prefetcher:
+    """Run `stage(item)` in one worker thread up to `depth` items ahead.
+
+    `iterate(items)` yields staged results strictly in input order — no
+    drops, no reordering, whatever the relative speed of producer and
+    consumer. The worker is created per iteration and torn down when the
+    generator closes (including early exits: a training loop that stops
+    on tol simply abandons the generator and the speculative staging work
+    is cancelled). `depth` defaults to `config.input_prefetch_depth`.
+    """
+
+    def __init__(self, stage: Callable[[Any], Any], depth: Optional[int] = None):
+        from .. import config
+
+        self.stage = stage
+        self.depth = max(1, int(depth if depth is not None else config.input_prefetch_depth))
+
+    def iterate(self, items: Iterable) -> Iterator:
+        metrics.set_gauge("prefetch.depth", self.depth)
+        it = iter(items)
+        pending: deque = deque()
+        executor = ThreadPoolExecutor(max_workers=1)
+        try:
+            exhausted = False
+            while True:
+                while not exhausted and len(pending) < self.depth:
+                    item = next(it, _SENTINEL)
+                    if item is _SENTINEL:
+                        exhausted = True
+                        break
+                    pending.append(executor.submit(self.stage, item))
+                if not pending:
+                    return
+                yield pending.popleft().result()
+        finally:
+            executor.shutdown(wait=False, cancel_futures=True)
+
+
+_SENTINEL = object()

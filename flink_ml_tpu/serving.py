@@ -36,61 +36,17 @@ import numpy as np
 
 from . import config
 from .obs import tracing
+from .parallel.prefetch import next_bucket, pad_rows, slice_rows, stage_to_device
 from .pipeline import PipelineModel, _drain_guards
 from .table import SparseBatch, Table
 from .utils import metrics
 
 __all__ = ["MicroBatchServer", "serve_stream"]
 
-
-def _next_bucket(n: int, buckets: Optional[Sequence[int]]) -> int:
-    """Smallest bucket >= n. Default schedule: powers of two (>= 8), the
-    classic recompile-bounding shape schedule; an explicit sorted bucket
-    list wins when the traffic distribution is known."""
-    if n <= 0:
-        return n  # empty batch: nothing to pad
-    if buckets:
-        for b in buckets:
-            if b >= n:
-                return int(b)
-        return int(n)  # beyond the largest bucket: exact shape
-    b = 8
-    while b < n:
-        b <<= 1
-    return b
-
-
-def _pad_rows(col, n: int, bucket: int):
-    """Pad a column from n to bucket rows by repeating its final row (a
-    real row: guard-safe). Works for host numpy, device arrays and
-    SparseBatch; object columns pad on host."""
-    if bucket == n:
-        return col
-    if isinstance(col, SparseBatch):
-        return SparseBatch(
-            col.size,
-            _pad_rows(col.indices, n, bucket),
-            _pad_rows(col.values, n, bucket),
-        )
-    try:
-        import jax
-
-        if isinstance(col, jax.Array):
-            import jax.numpy as jnp
-
-            reps = jnp.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
-            return jnp.concatenate([col, reps])
-    except ImportError:  # pragma: no cover
-        pass
-    col = np.asarray(col)
-    reps = np.broadcast_to(col[n - 1 :], (bucket - n,) + col.shape[1:])
-    return np.concatenate([col, reps])
-
-
-def _slice_rows(col, n: int):
-    if isinstance(col, SparseBatch):
-        return SparseBatch(col.size, col.indices[:n], col.values[:n])
-    return col[:n]
+# The bucket schedule and repeat-last-row pad now live in
+# parallel/prefetch.py, shared with the stream-training staging paths —
+# same policy, same guard-safety argument, one implementation.
+_next_bucket, _pad_rows, _slice_rows = next_bucket, pad_rows, slice_rows
 
 
 class MicroBatchServer:
@@ -137,12 +93,10 @@ class MicroBatchServer:
             else:
                 cols[name] = col
         if uploads:
-            import jax
-
             from .table import register_device_pytrees
 
             register_device_pytrees()  # SparseBatch uploads as a pytree
-            uploads = jax.device_put(uploads)
+            uploads = stage_to_device(uploads)  # accounted: h2d.bytes/count
         return Table(
             {name: uploads.get(name, cols.get(name)) for name in batch.column_names}
         ), n

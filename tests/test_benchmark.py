@@ -89,6 +89,7 @@ class TestRunner:
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
+            "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
         assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}

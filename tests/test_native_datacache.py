@@ -3,6 +3,8 @@ DataCacheWriteReadTest.java / DataCacheSnapshotTest.java /
 ReplayOperatorTest.java shapes: segment roundtrips, spill-under-budget,
 replayable streams."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -82,6 +84,64 @@ def test_object_columns_rejected():
     replay = ReplayableStreamTable(iter([t]))
     with pytest.raises(TypeError):
         list(replay)
+
+
+def test_close_removes_spill_file(tmp_path):
+    cache = DataCache(memory_budget_bytes=128, spill_dir=str(tmp_path))
+    for i in range(4):
+        cache.append_array(np.arange(100, dtype=np.float64))
+    assert cache.spilled_segments > 0
+    spill_path = cache._spill_path
+    assert os.path.exists(spill_path), "spill must hit disk for this test to bite"
+    cache.close()
+    assert not os.path.exists(spill_path), "stale spill file left behind on close"
+    cache.close()  # idempotent
+
+
+def test_del_removes_spill_file(tmp_path):
+    import gc
+
+    cache = DataCache(memory_budget_bytes=128, spill_dir=str(tmp_path))
+    cache.append_array(np.arange(200, dtype=np.float64))
+    spill_path = cache._spill_path
+    assert os.path.exists(spill_path)
+    del cache
+    gc.collect()
+    assert not os.path.exists(spill_path), "stale spill file survived __del__"
+
+
+def test_close_removes_file_even_without_native_destroy(tmp_path):
+    """The host-side cleanup holds even when the native teardown did not
+    remove the file (crashed native side / older library): close() with a
+    dead handle still deletes the segment store."""
+    cache = DataCache(memory_budget_bytes=128, spill_dir=str(tmp_path))
+    cache.append_array(np.arange(200, dtype=np.float64))
+    spill_path = cache._spill_path
+    cache._lib.dc_destroy(cache._handle)  # native gone, file still tracked
+    cache._handle = None
+    with open(spill_path, "wb") as f:  # simulate the leftover store
+        f.write(b"stale")
+    cache.close()
+    assert not os.path.exists(spill_path)
+
+
+def test_read_array_is_writable_native_and_fallback():
+    """In-place consumers (scalers normalizing a replayed batch) mutate
+    the returned array; a read-only frombuffer view would crash them."""
+    native = DataCache(memory_budget_bytes=1 << 20)
+    fallback = DataCache.__new__(DataCache)
+    fallback._lib, fallback._handle = None, None
+    fallback._segments, fallback._meta, fallback._spilled = [], [], []
+    for cache in (native, fallback):
+        seg = cache.append_array(np.arange(6, dtype=np.float64).reshape(2, 3))
+        got = cache.read_array(seg)
+        assert got.flags.writeable
+        got *= 2.0  # must not raise
+        # the stored segment is untouched: a second read sees the original
+        np.testing.assert_array_equal(
+            cache.read_array(seg), np.arange(6, dtype=np.float64).reshape(2, 3)
+        )
+    native.close()
 
 
 def test_parse_csv_doubles():

@@ -1,0 +1,55 @@
+"""Tier-1 gate for scripts/check_upload_accounting.py: no raw
+`jax.device_put` / `jax.make_array_from_callback` in models/ or ops/ may
+bypass the accounted stager in parallel/prefetch.py — the `h2d.*`
+counters (and the BENCH `h2dBytes` field) must stay an exhaustive
+host→device traffic inventory."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_upload_accounting",
+        os.path.join(REPO, "scripts", "check_upload_accounting.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_no_raw_uploads_in_models_or_ops():
+    checker = _load_checker()
+    violations = checker.find_violations()
+    assert not violations, (
+        "raw host->device transfers bypassing the accounted stager:\n"
+        + "\n".join(f"  {path}:{line}: jax.{prim}" for path, line, prim in violations)
+    )
+
+
+def test_gate_catches_a_planted_violation(tmp_path):
+    """The scanner itself works: a planted raw device_put (outside a
+    comment or string) is reported; the same text inside a docstring is
+    not, and the stager's own name does not false-positive."""
+    checker = _load_checker()
+    planted = tmp_path / "models"
+    planted.mkdir()
+    (planted / "bad.py").write_text(
+        '"""jax.device_put(x) in a docstring is fine."""\n'
+        "import jax\n"
+        "from flink_ml_tpu.parallel.prefetch import stage_to_device\n"
+        "# jax.device_put(x) in a comment is fine\n"
+        "def f(x):\n"
+        "    y = stage_to_device(x)  # the sanctioned funnel\n"
+        "    return jax.device_put(y)\n"
+    )
+    old_root, old_dirs = checker.ROOT, checker.SCANNED_DIRS
+    try:
+        checker.ROOT = str(tmp_path)
+        checker.SCANNED_DIRS = ("models",)
+        violations = checker.find_violations()
+    finally:
+        checker.ROOT, checker.SCANNED_DIRS = old_root, old_dirs
+    assert violations == [(os.path.join("models", "bad.py"), 7, "device_put")]
