@@ -631,6 +631,106 @@ def bench_input_pipeline(num_batches=8, batch_rows=20_000, d=64, epochs=6):
     return result
 
 
+def bench_checkpoint_resume(n=200_000, d=64, max_iter=24, kill_after_chunks=8):
+    """The preemption-safety workload (ISSUE 6): dense SGD with JobSnapshot
+    checkpointing every epoch. Reports (a) snapshot cost — wall delta per
+    epoch vs the same fit without checkpointing, plus the checkpoint.bytes/
+    count the run actually wrote; (b) resume-to-first-step wall — restore
+    the snapshot and advance ONE epoch (the recovery-latency number: how
+    long after a preemption the job is training again); (c) bit-identity —
+    a fit killed mid-training by the fault harness and resumed must land on
+    the uninterrupted run's exact coefficients (asserted in-process)."""
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu.ckpt import InjectedFault, faults
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(17)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.float32)
+    B = 20_000
+
+    def fit(ckpt_dir=None, max_iter=max_iter):
+        sgd = SGD(
+            max_iter=max_iter, global_batch_size=B, tol=0.0,
+            checkpoint_dir=ckpt_dir, checkpoint_interval=1,
+            checkpoint_key="checkpointResume",  # namespaced: no un-keyed warning
+        )
+        t0 = time.perf_counter()
+        coeff, _, epochs = sgd.optimize(
+            np.zeros(d, np.float32), X, y, None, BINARY_LOGISTIC_LOSS
+        )
+        return coeff, epochs, time.perf_counter() - t0
+
+    work = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        fit()  # compile warmup (both the plain and chunked programs)
+        fit(os.path.join(work, "warm"))
+        _, _, plain_wall = fit()
+        before = metrics.snapshot()
+        # the uninterrupted reference for the bit-identity assert runs the
+        # SAME checkpointed (chunked) program as the killed fit — the flat
+        # single-shard path is a different batch layout (allclose, not
+        # bit-equal, to the batched one)
+        expected, _, ckpt_wall = fit(os.path.join(work, "cadence"))
+        delta = metrics.snapshot_delta(before, metrics.snapshot())["counters"]
+        save_count = int(delta.get("checkpoint.count", 0))
+        save_bytes = int(delta.get("checkpoint.bytes", 0))
+
+        # kill mid-training at a chunk boundary, then resume to completion
+        kill_dir = os.path.join(work, "kill")
+        killed_at = None
+        try:
+            with faults.inject("chunk", after=kill_after_chunks):
+                fit(kill_dir)
+        except InjectedFault as e:
+            killed_at = e.hits
+        assert killed_at is not None, "fault never fired — raise max_iter"
+        resumed, epochs, resume_wall = fit(kill_dir)
+        bit_identical = bool(np.array_equal(np.asarray(resumed), np.asarray(expected)))
+        assert bit_identical, "kill -> resume diverged from the uninterrupted fit"
+
+        # recovery latency: restore the snapshot and advance ONE epoch
+        first_dir = os.path.join(work, "first")
+        try:
+            with faults.inject("chunk", after=kill_after_chunks):
+                fit(first_dir)
+        except InjectedFault:
+            pass
+        t0 = time.perf_counter()
+        _, first_epochs, _ = fit(first_dir, max_iter=kill_after_chunks + 1)
+        resume_to_first_step = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    result = {
+        "numRows": n,
+        "dim": d,
+        "maxIter": max_iter,
+        "plainWallMs": plain_wall * 1000.0,
+        "checkpointedWallMs": ckpt_wall * 1000.0,
+        "saveMsPerEpoch": (ckpt_wall - plain_wall) * 1000.0 / max_iter,
+        "checkpointCount": save_count,
+        "checkpointBytes": save_bytes,
+        "checkpointBytesPerSave": save_bytes / max(1, save_count),
+        "killedAtChunk": killed_at,
+        "resumeWallMs": resume_wall * 1000.0,
+        "resumeToFirstStepMs": resume_to_first_step * 1000.0,
+        "resumedEpochs": int(epochs),
+        "bitIdenticalToUninterrupted": bit_identical,  # asserted above
+    }
+    log(
+        f"checkpointResume: save {result['saveMsPerEpoch']:.2f}ms/epoch "
+        f"({result['checkpointBytesPerSave'] / 1e3:.1f}KB/save, "
+        f"{save_count} saves), kill@chunk {killed_at} -> resume-to-first-step "
+        f"{result['resumeToFirstStepMs']:.1f}ms, bit-identical resume"
+    )
+    return result
+
+
 def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
     """The comm-layer workload (ISSUE 4): per-device-count collective
     traffic and wall time from scripts/bench_collectives.py — bucketed
@@ -702,6 +802,7 @@ def main(argv):
         "kmeans": None,
         "pipelineServing": None,
         "inputPipeline": None,
+        "checkpointResume": None,
         "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
@@ -783,6 +884,12 @@ def main(argv):
                 details["inputPipeline"] = bench_input_pipeline()
             except Exception as e:
                 log(f"inputPipeline stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["checkpointResume"] = bench_checkpoint_resume()
+            except Exception as e:
+                log(f"checkpointResume stage failed: {e!r}")
 
         if in_budget():
             try:

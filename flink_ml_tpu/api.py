@@ -10,7 +10,7 @@ Estimator.java:30) with Tables replaced by the columnar Table of
 from __future__ import annotations
 
 import abc
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from .param import WithParams
 from .table import Table
@@ -215,7 +215,21 @@ class Model(Transformer):
 
 
 class Estimator(Stage):
-    """A stage that fits a Model from training tables (Estimator.java:30)."""
+    """A stage that fits a Model from training tables (Estimator.java:30).
+
+    Checkpoint contract (enforced by scripts/check_checkpoint_coverage.py,
+    tier-1 via tests/test_checkpoint_coverage.py): every concrete
+    estimator must declare `checkpointable`. True means its iterative fit
+    routes through the JobSnapshot API (flink_ml_tpu/ckpt/) — via
+    `run_sgd`/`optimize_stream`, `iterate_unbounded`, or direct
+    `save_job_snapshot`/`load_job_snapshot` calls — so a preempted fit
+    resumes from the last epoch boundary under the process-wide
+    `config.iteration_checkpoint_dir`. False requires a non-empty
+    `checkpoint_reason` saying why there is no resumable mid-fit state
+    (e.g. a single-pass aggregation whose restart simply recomputes)."""
+
+    checkpointable: Optional[bool] = None
+    checkpoint_reason: str = ""
 
     @abc.abstractmethod
     def fit(self, *inputs: Table) -> Model:

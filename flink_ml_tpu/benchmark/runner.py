@@ -162,6 +162,11 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "h2dCount": int(delta["counters"].get("h2d.count", 0)),
         "deviceCacheHits": int(delta["counters"].get("devicecache.hit", 0)),
         "deviceCacheMisses": int(delta["counters"].get("devicecache.miss", 0)),
+        # checkpoint-subsystem evidence (ckpt/snapshot.py): snapshots this
+        # entry wrote and the bytes they gathered — a jump between BENCH
+        # files means a loop's snapshot cadence (or payload) changed
+        "checkpointCount": int(delta["counters"].get("checkpoint.count", 0)),
+        "checkpointBytes": int(delta["counters"].get("checkpoint.bytes", 0)),
         # per-op collective traffic this entry traced (calls/bytes/chunks
         # from the accounted wrappers in parallel/collectives.py, plus the
         # sparse-vs-dense byte ratio when a sparse reduce ran) — the

@@ -1,0 +1,27 @@
+"""Preemption-safe job checkpointing: the JobSnapshot format + the
+fault-injection harness that proves it (see `snapshot.py` / `faults.py`,
+and docs/fault_tolerance.md for the contracts)."""
+
+from .faults import FaultPlan, InjectedFault, failing_map, inject, tick
+from .snapshot import (
+    SNAPSHOT_VERSION,
+    JobSnapshot,
+    load_job_snapshot,
+    save_job_snapshot,
+    snapshot_file,
+    stage_section,
+)
+
+__all__ = [
+    "SNAPSHOT_VERSION",
+    "JobSnapshot",
+    "load_job_snapshot",
+    "save_job_snapshot",
+    "snapshot_file",
+    "stage_section",
+    "FaultPlan",
+    "InjectedFault",
+    "failing_map",
+    "inject",
+    "tick",
+]

@@ -1,0 +1,133 @@
+"""Fault-injection harness — the reference's `FailingMap` idiom.
+
+The reference proves its checkpoint subsystem with integration tests that
+plant a map function which throws after N records, forcing a restore from
+the last completed checkpoint and asserting exactly-once results
+(flink-ml-tests/.../BoundedAllRoundCheckpointITCase.java:75-168). Here the
+"job" is a host-driven training loop, so a failure is an exception thrown
+out of the loop at a controlled point. Two entry styles:
+
+- `failing_map(items, after_records)` — the literal FailingMap: wrap any
+  input stream (host chunks, StreamTable batches) and it raises
+  `InjectedFault` once the cumulative record count crosses the threshold.
+  Standalone; no arming needed.
+
+- `inject(site, after)` + `tick(site)` — in-loop injection points. The
+  training loops call `tick(<site>)` at their natural boundaries; a test
+  arms ONE plan with `inject(...)` and the matching tick raises. Sites
+  wired in:
+
+  | site             | boundary                                          |
+  |------------------|---------------------------------------------------|
+  | `chunk`          | bounded chunk drained (SGD checkpointed loop,     |
+  |                  | `iterate_bounded` host-driven loop)               |
+  | `epoch`          | stream-training epoch drained (SGD `optimize_     |
+  |                  | stream`, KMeans out-of-core epoch)                |
+  | `batch`          | unbounded global batch folded (`iterate_          |
+  |                  | unbounded` — the online estimators)               |
+  | `snapshot.write` | INSIDE `save_job_snapshot`, after the temp file   |
+  |                  | is written but before the atomic `os.replace` —   |
+  |                  | the torn-write case the atomicity contract covers |
+
+  Ticks fire AFTER the boundary's snapshot save, so an injected kill
+  models a crash between a completed checkpoint and the next boundary —
+  except `snapshot.write`, which models the crash mid-checkpoint.
+
+Disarmed cost is one module-global load per tick — safe on hot loops.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = ["InjectedFault", "FaultPlan", "inject", "tick", "armed", "failing_map"]
+
+
+class InjectedFault(RuntimeError):
+    """The planted failure. Deliberately NOT a subclass of any framework
+    error: tests assert the kill propagated un-swallowed."""
+
+    def __init__(self, site: str, hits: int):
+        super().__init__(f"injected fault at site {site!r} (hit {hits})")
+        self.site = site
+        self.hits = hits
+
+
+@dataclass
+class FaultPlan:
+    """One armed failure: raise at the `after`-th hit of `site`."""
+
+    site: str
+    after: int
+    hits: int = 0
+    fired: bool = False
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def armed() -> bool:
+    return _plan is not None
+
+
+@contextmanager
+def inject(site: str, after: int = 1):
+    """Arm a fault plan for the enclosed block (one plan at a time; plans
+    restore on exit, so nesting shadows). Yields the plan so tests can
+    inspect `hits`/`fired` afterwards."""
+    global _plan
+    prev = _plan
+    plan = FaultPlan(site, max(1, int(after)))
+    _plan = plan
+    try:
+        yield plan
+    finally:
+        _plan = prev
+
+
+def tick(site: str, count: int = 1) -> None:
+    """Record `count` hits of an injection site; raises `InjectedFault`
+    when the armed plan's threshold is crossed (once — a fired plan stays
+    quiet so cleanup code re-entering the site cannot double-throw)."""
+    plan = _plan
+    if plan is None or plan.fired or plan.site != site:
+        return
+    plan.hits += count
+    if plan.hits >= plan.after:
+        plan.fired = True
+        raise InjectedFault(site, plan.hits)
+
+
+def _default_records(item: Any) -> int:
+    """Record count of one stream item: a Table-like (num_rows), an
+    (X, y, w) chunk tuple, or a bare array; anything else counts 1."""
+    rows = getattr(item, "num_rows", None)
+    if rows is not None:
+        return int(rows)
+    probe = item[0] if isinstance(item, tuple) and len(item) else item
+    shape = getattr(probe, "shape", None)
+    if shape:
+        return int(shape[0])
+    return 1
+
+
+def failing_map(
+    items: Iterable,
+    after_records: int,
+    site: str = "record",
+    records: Optional[Callable[[Any], int]] = None,
+) -> Iterator:
+    """The FailingMap idiom: pass items through, raising `InjectedFault`
+    once `after_records` cumulative records have been yielded. The item
+    that crosses the threshold is NOT yielded (the failure lands at an
+    arbitrary record boundary, mid-stream). Standalone — no `inject`
+    arming required."""
+    count = records if records is not None else _default_records
+    seen = 0
+    for item in items:
+        seen += count(item)
+        if seen >= after_records:
+            raise InjectedFault(site, seen)
+        yield item

@@ -294,6 +294,8 @@ def _ids(v):
 
 class Graph(Estimator):
     """An Estimator DAG (builder/Graph.java:54)."""
+    checkpointable = False
+    checkpoint_reason = "composite stage: each contained estimator snapshots its own fit through config.iteration_checkpoint_dir; the graph itself holds no training state"
 
     def __init__(
         self,

@@ -122,6 +122,8 @@ class KnnModel(Model, KnnModelParams):
 
 
 class Knn(Estimator, KnnParams):
+    checkpointable = False
+    checkpoint_reason = "fit materializes the training set as the model (no iterations); a restart recomputes the repack"
     def fit(self, *inputs: Table) -> KnnModel:
         """Packs the training set as the model (Knn.java) — lazily: device
         columns stay device-resident (no D2H pull at fit; transform's

@@ -155,6 +155,8 @@ class LinearSVCModel(Model, LinearSVCModelParams):
 
 class LinearSVC(Estimator, LinearSVCParams):
     """Estimator (LinearSVC.java)."""
+    # SGD fit routes through run_sgd -> JobSnapshot checkpoints
+    checkpointable = True
 
     def fit(self, *inputs: Table) -> LinearSVCModel:
         (table,) = inputs

@@ -149,6 +149,8 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
 
 class LogisticRegression(Estimator, LogisticRegressionParams):
     """Estimator (LogisticRegression.java:60)."""
+    # SGD fit routes through run_sgd -> JobSnapshot checkpoints
+    checkpointable = True
 
     def fit(self, *inputs: Table) -> LogisticRegressionModel:
         (table,) = inputs

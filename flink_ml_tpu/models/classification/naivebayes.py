@@ -368,6 +368,8 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
 
 
 class NaiveBayes(Estimator, NaiveBayesParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass label/feature count aggregation; a restart recomputes the fit"
     def _fit_stats_device(self, X, y):
         """(labels, per-label counts, per-column category values, per-pair
         co-occurrence counts) aggregated on device: column sorts for the

@@ -174,6 +174,9 @@ class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
 class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
     """Estimator (OnlineLogisticRegression.java). Requires initial model
     data (e.g. from batch LogisticRegression)."""
+    # unbounded fit snapshots (coeff, z, n, stream offset) per global
+    # batch through iterate_unbounded -> JobSnapshot
+    checkpointable = True
 
     def __init__(self):
         self._initial_model_data: Optional[Table] = None

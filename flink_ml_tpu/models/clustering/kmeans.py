@@ -234,6 +234,11 @@ def _unit_weights(n, n_pad, sharding):
 
 
 class KMeans(Estimator, KMeansParams):
+    # out-of-core (StreamTable) fits snapshot (centroids, counts, rng)
+    # at epoch boundaries through the JobSnapshot API; the in-memory
+    # fit is ONE device program, so its preemption unit is the whole
+    # fit (re-dispatch recomputes — nothing host-visible to snapshot)
+    checkpointable = True
     def fit(self, *inputs) -> KMeansModel:
         (table,) = inputs
         from ...table import StreamTable
@@ -411,8 +416,47 @@ class KMeans(Estimator, KMeansParams):
             packed_dev = h2d.stage_to_device(packed, mat_sharding)
             return _unpack_points(packed_dev, d, mat_sharding, row_sharding)
 
+        # Checkpoint/resume (ckpt/snapshot.py): an epoch boundary is the
+        # only consistent cut — the (sums, counts) partials reset per
+        # epoch, so the snapshot is just (centroids, epoch) plus the host
+        # RNG state (init sampling re-derives deterministically from the
+        # seed, but the generator's post-init state is job state and
+        # travels with the job). Keyed by the stage's param-hash job key;
+        # `numBatches` in meta refuses a snapshot from a different stream
+        # layout (the epoch→batch replay mapping would diverge).
+        from ...ckpt import faults
+        from ...ckpt import snapshot as _snapshot
+        from ...parallel.iteration import checkpoint_job_key
+
+        ckpt_dir = config.iteration_checkpoint_dir
+        interval = max(1, int(config.iteration_checkpoint_interval))
+        job_key = checkpoint_job_key(self) if ckpt_dir is not None else None
+        start_epoch = 0
+        counts = jnp.zeros((k,), jnp.float32)
+        if ckpt_dir is not None:
+            snap = _snapshot.load_job_snapshot(
+                ckpt_dir,
+                job_key,
+                templates={"model": (init, np.zeros(k, np.float32))},
+                expect_meta={"numBatches": nb},
+            )
+            if snap is not None:
+                restored_centroids, restored_counts = snap.sections["model"]
+                centroids = jnp.asarray(restored_centroids)
+                counts = jnp.asarray(restored_counts)
+                start_epoch = snap.epoch
+                if "rng" in snap.sections:
+                    keys, pos = snap.sections["rng"]
+                    rng.set_state(
+                        ("MT19937", keys, int(pos[0]), int(pos[1]), float(pos[2]))
+                    )
+
+        def rng_section():
+            _, keys, pos, has_gauss, cached = rng.get_state()
+            return (np.asarray(keys), np.asarray([pos, has_gauss, cached], np.float64))
+
         loader = CachedEpochLoader(stage)
-        for _ in range(self.get_max_iter()):
+        for epoch in range(start_epoch, self.get_max_iter()):
             sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
             counts = jnp.zeros((k,), jnp.float32)
             for batch in loader.epoch(range(nb)):
@@ -424,6 +468,16 @@ class KMeans(Estimator, KMeansParams):
                 sums / jnp.maximum(counts[:, None], 1e-30),
                 centroids,
             )
+            if ckpt_dir is not None and (epoch + 1) % interval == 0:
+                _snapshot.save_job_snapshot(
+                    ckpt_dir,
+                    job_key,
+                    {"model": (centroids, counts), "rng": rng_section()},
+                    epoch=epoch + 1,
+                    specs={"rng": "host"},
+                    meta={"numBatches": nb},
+                )
+            faults.tick("epoch")
 
         from ...utils.packing import packed_device_get
 

@@ -184,6 +184,9 @@ class OnlineKMeansModel(Model, KMeansModelParams):
 class OnlineKMeans(Estimator, OnlineKMeansParams):
     """Estimator (OnlineKMeans.java:44-60). Requires initial model data —
     from batch KMeans or `generate_random_model_data`."""
+    # unbounded fit snapshots (state, stream offset) per global batch
+    # through iterate_unbounded -> JobSnapshot
+    checkpointable = True
 
     def __init__(self):
         self._initial_model_data: Optional[Table] = None

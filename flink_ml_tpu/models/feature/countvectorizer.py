@@ -182,6 +182,8 @@ class CountVectorizerModel(Model, CountVectorizerModelParams):
 
 
 class CountVectorizer(Estimator, CountVectorizerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass vocabulary count over the input; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> CountVectorizerModel:
         (table,) = inputs
         col = table.column(self.get_input_col())

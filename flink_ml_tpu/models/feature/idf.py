@@ -127,6 +127,8 @@ class IDFModel(Model, IDFModelParams):
 
 
 class IDF(Estimator, IDFParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass document-frequency count; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> IDFModel:
         (table,) = inputs
         col = table.column(self.get_input_col())

@@ -217,6 +217,8 @@ class ImputerModel(Model, ImputerModelParams):
 
 
 class Imputer(Estimator, ImputerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass surrogate aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> ImputerModel:
         (table,) = inputs
         from ...table import StreamTable

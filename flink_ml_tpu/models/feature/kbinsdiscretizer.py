@@ -187,6 +187,8 @@ def _bin_all(X, edges_mat, nbins):
 
 
 class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass quantile/width binning; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> KBinsDiscretizerModel:
         (table,) = inputs
         from ...table import StreamTable

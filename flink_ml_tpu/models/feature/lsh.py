@@ -207,6 +207,8 @@ class MinHashLSHModel(Model, LSHParams):
 
 
 class MinHashLSH(Estimator, MinHashLSHParams):
+    checkpointable = False
+    checkpoint_reason = "fit only derives seeded hash coefficients; deterministic recompute on restart"
     def fit(self, *inputs: Table) -> MinHashLSHModel:
         (table,) = inputs
         batch = as_sparse_batch(table.column(self.get_input_col()))

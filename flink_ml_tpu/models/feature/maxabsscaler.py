@@ -78,6 +78,8 @@ class MaxAbsScalerModel(Model, MaxAbsScalerParams):
 
 
 class MaxAbsScaler(Estimator, MaxAbsScalerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass abs-max aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> MaxAbsScalerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

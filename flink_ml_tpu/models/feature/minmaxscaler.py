@@ -137,6 +137,8 @@ def _column_min_max(X):
 
 
 class MinMaxScaler(Estimator, MinMaxScalerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass min/max aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> MinMaxScalerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

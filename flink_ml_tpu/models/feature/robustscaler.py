@@ -154,6 +154,8 @@ def _quantiles(X, qs):
 
 
 class RobustScaler(Estimator, RobustScalerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass quantile aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> RobustScalerModel:
         (table,) = inputs
         from ...table import StreamTable

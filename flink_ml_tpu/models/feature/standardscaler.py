@@ -122,6 +122,8 @@ class StandardScalerModel(Model, StandardScalerParams):
 
 
 class StandardScaler(Estimator, StandardScalerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass moment aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> StandardScalerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

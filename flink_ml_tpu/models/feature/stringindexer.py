@@ -259,6 +259,8 @@ class IndexToStringModel(Model, IndexToStringModelParams):
 
 
 class StringIndexer(Estimator, StringIndexerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass frequency count over the input; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> StringIndexerModel:
         (table,) = inputs
         order = self.get_string_order_type()

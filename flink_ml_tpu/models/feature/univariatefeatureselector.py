@@ -168,6 +168,8 @@ class UnivariateFeatureSelectorModel(Model, UnivariateFeatureSelectorModelParams
 
 
 class UnivariateFeatureSelector(Estimator, UnivariateFeatureSelectorParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass statistical test over the input; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> UnivariateFeatureSelectorModel:
         (table,) = inputs
         feature_type = self.get_feature_type()

@@ -99,6 +99,8 @@ def _sample_variance(X):
 
 
 class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass variance aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> VarianceThresholdSelectorModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

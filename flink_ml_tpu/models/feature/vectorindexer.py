@@ -137,6 +137,8 @@ _nunique_per_column = lazy_jit(_nunique_impl)
 
 
 class VectorIndexer(Estimator, VectorIndexerParams):
+    checkpointable = False
+    checkpoint_reason = "single-pass distinct-value aggregation; a restart recomputes the fit"
     def fit(self, *inputs: Table) -> VectorIndexerModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)

@@ -118,6 +118,8 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
 
 class LinearRegression(Estimator, LinearRegressionParams):
     """Estimator (LinearRegression.java:48)."""
+    # SGD fit routes through run_sgd -> JobSnapshot checkpoints
+    checkpointable = True
 
     def fit(self, *inputs: Table) -> LinearRegressionModel:
         (table,) = inputs

@@ -557,7 +557,7 @@ class SGD:
             init = h2d.stage_to_device(init, mesh_lib.model_sharding(mesh))
         if self.checkpoint_dir is not None:
             coeff, criteria, epochs = self._optimize_with_checkpoints(
-                X_b, y_b, w_b, init, loss_func
+                X_b, y_b, w_b, init, loss_func, mesh
             )
             flag = None
             if validate_labels:
@@ -677,6 +677,7 @@ class SGD:
         row_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         mat_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS, None))
         hyper = self._hyper()
+        nb = len(segs)
         carry = (
             jnp.asarray(init_coeff, self.dtype),
             jnp.zeros((d,), self.dtype),
@@ -684,16 +685,24 @@ class SGD:
             jnp.asarray(0, jnp.int32),
         )
         epoch, criteria = 0, float("inf")
+        # segment count + batch size pin the epoch→segment mapping; a
+        # snapshot written against a different stream layout is refused
+        ckpt_meta = {
+            "numSegments": nb,
+            "globalBatchSize": int(self.global_batch_size),
+        }
         if self.checkpoint_dir is not None:
-            from ..parallel.iteration import load_iteration_checkpoint
+            from ..ckpt import snapshot as _snapshot
 
-            restored = load_iteration_checkpoint(
-                self.checkpoint_dir, carry, self.checkpoint_key
+            snap = _snapshot.load_job_snapshot(
+                self.checkpoint_dir,
+                self.checkpoint_key,
+                templates={"model": carry},
+                expect_meta=ckpt_meta,
             )
-            if restored is not None:
-                carry, epoch, criteria = restored
-                carry = tuple(jnp.asarray(leaf) for leaf in carry)
-        nb = len(segs)
+            if snap is not None:
+                carry = _snapshot.stage_section(snap, "model", mesh=mesh)
+                epoch, criteria = snap.epoch, snap.criteria
 
         # Input pipeline (data/devicecache.py + parallel/prefetch.py): the
         # device epoch cache serves replayed batches straight from HBM
@@ -708,6 +717,7 @@ class SGD:
         # criteria-guarded identity programs, so the stop epoch and
         # coefficients are exact (see _stream_epoch_impl).
         from .. import config
+        from ..ckpt import faults
         from ..data.devicecache import CachedEpochLoader
         from ..obs import tracing
         from ..parallel import dispatch
@@ -735,14 +745,21 @@ class SGD:
                     and e_act == entry.end
                     and e_act % interval == 0
                 ):
-                    from ..parallel.iteration import save_iteration_checkpoint
+                    from ..ckpt import snapshot as _snapshot
 
-                    save_iteration_checkpoint(
-                        self.checkpoint_dir, entry.carry, e_act, crit,
+                    _snapshot.save_job_snapshot(
+                        self.checkpoint_dir,
                         self.checkpoint_key,
+                        {"model": entry.carry},
+                        epoch=e_act,
+                        criteria=crit,
+                        # the device-epoch-cache key cursor: the segment
+                        # the next epoch after this snapshot replays
+                        meta={**ckpt_meta, "cacheCursor": e_act % nb},
                     )
                 if crit <= self.tol:
                     stopped = True
+                faults.tick("epoch")
 
         loader = CachedEpochLoader(fetch)
         batch_iter = loader.epoch(p % nb for p in range(epoch, self.max_iter))
@@ -853,7 +870,7 @@ class SGD:
             validate_labels,
         )
 
-    def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func):
+    def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func, mesh):
         """Checkpointed training as a pipeline of epoch CHUNKS: K epochs
         per device program (chunk ends clamp to checkpoint boundaries so
         the snapshot cadence is exact), one packed (epoch, criteria)
@@ -862,17 +879,24 @@ class SGD:
         check runs inside each chunk's while condition, so the stop epoch
         and coefficients match the old one-epoch-per-dispatch loop exactly;
         chunks dispatched past the tol-fire epoch are identity programs.
-        Carries of non-boundary chunks are donated (HBM ping-pong)."""
+        Carries of non-boundary chunks are donated (HBM ping-pong).
+
+        Snapshots ride the JobSnapshot format (ckpt/snapshot.py): the
+        carry section is tagged with its sharding specs, so a resume may
+        land on a mesh of a DIFFERENT device count and `stage_section`
+        re-shards the restored leaves onto it (elastic shrink/grow); the
+        batch schedule (`numBatches`, `globalBatchSize`) rides in meta so
+        a snapshot from a different data layout is refused, because the
+        epoch→batch mapping would silently diverge."""
         from .. import config
+        from ..ckpt import faults
+        from ..ckpt import snapshot as _snapshot
         from ..obs import tracing
         from ..parallel import dispatch
-        from ..parallel.iteration import (
-            load_iteration_checkpoint,
-            save_iteration_checkpoint,
-        )
         from ..utils.packing import packed_device_get
 
         d = init_coeff.shape[0]  # X_b may be the sparse (indices, values) tuple
+        nb = int(y_b.shape[0])
         hyper = self._hyper()
         carry = (
             jnp.asarray(init_coeff, self.dtype),
@@ -880,13 +904,27 @@ class SGD:
             jnp.asarray(0.0, self.dtype),
             jnp.asarray(0, jnp.int32),
         )
-        epoch, criteria = 0, float("inf")
-        restored = load_iteration_checkpoint(
-            self.checkpoint_dir, carry, self.checkpoint_key
+        # coeff and grad live feature-sharded in the tensor-parallel
+        # layout; everything else is replicated (snapshot leaves are full
+        # host arrays either way — the tags drive the restore staging)
+        carry_specs = (
+            ("model", "model", "replicated", "replicated")
+            if self.shard_features
+            else "replicated"
         )
-        if restored is not None:
-            carry, epoch, criteria = restored
-            carry = tuple(jnp.asarray(leaf) for leaf in carry)
+        ckpt_meta = {"numBatches": nb, "globalBatchSize": int(self.global_batch_size)}
+        epoch, criteria = 0, float("inf")
+        snap = _snapshot.load_job_snapshot(
+            self.checkpoint_dir,
+            self.checkpoint_key,
+            templates={"model": carry},
+            expect_meta=ckpt_meta,
+        )
+        if snap is not None:
+            carry = _snapshot.stage_section(
+                snap, "model", mesh=mesh, specs=carry_specs
+            )
+            epoch, criteria = snap.epoch, snap.criteria
             # the restored epoch counter must live in the carry (the chunk
             # kernel's loop condition reads carry[3])
             carry = carry[:3] + (jnp.asarray(epoch, jnp.int32),)
@@ -905,12 +943,18 @@ class SGD:
                 advanced = e_act > final_epoch
                 final_epoch, final_crit = e_act, crit
                 if advanced and e_act == entry.end and e_act % interval == 0:
-                    save_iteration_checkpoint(
-                        self.checkpoint_dir, entry.carry, e_act, crit,
+                    _snapshot.save_job_snapshot(
+                        self.checkpoint_dir,
                         self.checkpoint_key,
+                        {"model": entry.carry},
+                        epoch=e_act,
+                        criteria=crit,
+                        specs={"model": carry_specs},
+                        meta=ckpt_meta,
                     )
                 if crit <= self.tol:
                     stopped = True
+                faults.tick("chunk")
 
         with tracing.span(
             "iteration.run", mode="chunked", chunk=K, depth=queue.depth

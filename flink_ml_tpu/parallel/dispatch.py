@@ -30,6 +30,13 @@ and listener callbacks retain; everything else donates).
 
 Every blocking drain is accounted as `iteration.host_sync` (obs/tracing),
 so BENCH deltas surface dispatch regressions.
+
+Drain boundaries are also the job-checkpoint hook points: a drained chunk
+whose end lands on a checkpoint boundary (`next_boundary` clamps chunk
+ends so it always does) has its retained carry snapshotted through the
+JobSnapshot API (flink_ml_tpu/ckpt/snapshot.py) by the drain handlers in
+`parallel/iteration.py` and `ops/optimizer.py`, and the fault-injection
+`chunk` site ticks once per drained entry (docs/fault_tolerance.md).
 """
 
 from __future__ import annotations

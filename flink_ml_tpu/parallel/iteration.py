@@ -93,6 +93,10 @@ def _checkpoint_file(path: str, job_key: Optional[str]) -> str:
 def save_iteration_checkpoint(
     path: str, carry, epoch: int, criteria: float, job_key: Optional[str] = None
 ) -> None:
+    """LEGACY carry-only writer, kept for direct users and as the
+    migration source: the iteration loops themselves now snapshot through
+    the versioned JobSnapshot format (flink_ml_tpu/ckpt/snapshot.py),
+    whose loader also reads files this function wrote (one-way)."""
     from ..utils.packing import packed_device_get
 
     leaves = jax.tree_util.tree_leaves(carry)
@@ -116,35 +120,21 @@ def load_iteration_checkpoint(path: str, carry_like, job_key: Optional[str] = No
     """Restore (carry, epoch, criteria) from `path`, or None if absent OR
     structurally incompatible. With a `job_key` (see `checkpoint_job_key`)
     the lookup is namespaced per job, so structurally-identical jobs
-    sharing a directory stay isolated. The structural guard remains for
-    un-keyed callers: the checkpoint stores leaves positionally against
-    `carry_like`'s treedef; a leaf-count or leaf-shape mismatch means the
-    checkpoint belongs to a DIFFERENT job — restoring it positionally
-    would silently train from foreign state, so it is ignored."""
-    file = _checkpoint_file(path, job_key)
-    if not os.path.exists(file):
+    sharing a directory stay isolated; un-keyed restores WARN, because the
+    structural guard alone cannot tell two same-shaped jobs apart (leaves
+    restore positionally against `carry_like`'s treedef — a foreign but
+    compatible checkpoint would silently train from foreign state).
+
+    Reads the versioned JobSnapshot format first (the format the loops
+    write since the ckpt/ subsystem landed) and falls back to the legacy
+    carry-only npz this module used to write — both through
+    `ckpt.snapshot.load_job_snapshot`, so the guards live in one place."""
+    from ..ckpt import snapshot as _snapshot
+
+    snap = _snapshot.load_job_snapshot(path, job_key, templates={"model": carry_like})
+    if snap is None:
         return None
-    with np.load(file) as f:
-        leaves, treedef = jax.tree_util.tree_flatten(carry_like)
-        if any(f"leaf_{i}" not in f for i in range(len(leaves))) or (
-            f"leaf_{len(leaves)}" in f
-        ):
-            return None
-        for i, leaf in enumerate(leaves):
-            if hasattr(leaf, "shape") and tuple(f[f"leaf_{i}"].shape) != tuple(
-                np.shape(leaf)
-            ):
-                return None
-        # restore on host: np keeps float64 leaves exact (jnp would truncate
-        # under x64-off with a warning); the next jitted step device-puts
-        restored = [
-            np.asarray(f[f"leaf_{i}"], dtype=leaf.dtype)
-            if hasattr(leaf, "dtype")
-            else f[f"leaf_{i}"]
-            for i, leaf in enumerate(leaves)
-        ]
-        carry = jax.tree_util.tree_unflatten(treedef, restored)
-        return carry, int(f["epoch"]), float(f["criteria"])
+    return snap.sections["model"], snap.epoch, snap.criteria
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +150,7 @@ def iterate_bounded(
     checkpoint_dir: Optional[str] = None,
     checkpoint_interval: int = 1,
     chunk_size: Optional[int] = None,
+    job_key: Optional[str] = None,
 ) -> IterationResult:
     """Run `body(carry, epoch) -> (carry, criteria)` until termination.
 
@@ -186,6 +177,7 @@ def iterate_bounded(
         checkpoint_dir,
         checkpoint_interval,
         chunk_size,
+        job_key,
     )
 
 
@@ -229,6 +221,7 @@ def _iterate_host_driven(
     checkpoint_dir,
     checkpoint_interval,
     chunk_size=None,
+    job_key=None,
 ):
     """Pipelined host-driven loop.
 
@@ -247,12 +240,14 @@ def _iterate_host_driven(
     are bit-identical to the fully synchronous per-epoch loop.
     """
     from .. import config
+    from ..ckpt import faults
+    from ..ckpt import snapshot as _snapshot
     from ..utils import metrics
     from . import dispatch
 
     carry, epoch, criteria = init_carry, 0, float("inf")
     if checkpoint_dir is not None:
-        restored = load_iteration_checkpoint(checkpoint_dir, init_carry)
+        restored = load_iteration_checkpoint(checkpoint_dir, init_carry, job_key)
         if restored is not None:
             carry, epoch, criteria = restored
 
@@ -283,9 +278,16 @@ def _iterate_host_driven(
                 and e_act == entry.end
                 and e_act % checkpoint_interval == 0
             ):
-                save_iteration_checkpoint(checkpoint_dir, entry.carry, e_act, crit)
+                _snapshot.save_job_snapshot(
+                    checkpoint_dir,
+                    job_key,
+                    {"model": entry.carry},
+                    epoch=e_act,
+                    criteria=crit,
+                )
             if tol is not None and crit <= tol:
                 stopped = True
+            faults.tick("chunk")
 
     mode = "host" if per_epoch else "chunked"
     with tracing.span(
@@ -379,6 +381,9 @@ def iterate_unbounded(
     state + in-flight feedback records; here a batch boundary is the only
     consistent cut, so there are no in-flight records to log).
     """
+    from ..ckpt import faults
+    from ..ckpt import snapshot as _snapshot
+
     if checkpoint_dir is None:
         from .. import config
 
@@ -410,13 +415,25 @@ def iterate_unbounded(
         if listener is not None:
             listener.on_epoch_watermark_incremented(version, state)
         if checkpoint_dir is not None and version % interval == 0:
-            save_iteration_checkpoint(checkpoint_dir, state, version, 0.0, job_key)
+            # the version IS the stream offset in global batches — stored
+            # in meta so a resume against a non-replayed source is caught
+            _snapshot.save_job_snapshot(
+                checkpoint_dir,
+                job_key,
+                {"model": state},
+                epoch=version,
+                meta={"streamOffset": version},
+            )
+        faults.tick("batch")
         yield version, state
     if checkpoint_dir is not None:
         # the stream completed: clear the checkpoint so a NEW job reusing
         # this dir does not resume from (and skip past) a finished run
-        file = _checkpoint_file(checkpoint_dir, job_key)
-        if os.path.exists(file):
-            os.remove(file)
+        for file in (
+            _snapshot.snapshot_file(checkpoint_dir, job_key),
+            _checkpoint_file(checkpoint_dir, job_key),
+        ):
+            if os.path.exists(file):
+                os.remove(file)
     if listener is not None:
         listener.on_iteration_terminated(state)

@@ -337,6 +337,8 @@ class PipelineModel(Model):
 
 class Pipeline(Estimator):
     """Sequential Estimator (builder/Pipeline.java:79-107)."""
+    checkpointable = False
+    checkpoint_reason = "composite stage: each contained estimator snapshots its own fit through config.iteration_checkpoint_dir; the pipeline itself holds no training state"
 
     def __init__(self, stages: Sequence[Stage] = ()):
         self._stages: List[Stage] = list(stages)

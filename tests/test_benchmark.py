@@ -90,6 +90,7 @@ class TestRunner:
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
+            "checkpointCount", "checkpointBytes",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
         assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}

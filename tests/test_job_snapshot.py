@@ -1,0 +1,328 @@
+"""JobSnapshot format battery (flink_ml_tpu/ckpt/snapshot.py): roundtrip
+fidelity, the atomicity contract under torn writes (kill injected DURING a
+save leaves the previous snapshot intact and restorable), format
+versioning, the foreign-job guards, one-way legacy migration, elastic
+re-staging across meshes, and the checkpoint.* observability."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu.ckpt import (
+    InjectedFault,
+    faults,
+    load_job_snapshot,
+    save_job_snapshot,
+    snapshot_file,
+    stage_section,
+)
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# format roundtrip
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_multisection(tmp_path):
+    jnp = _jnp()
+    model = (jnp.arange(6, dtype=jnp.float32), np.float64([1.5, -2.5]), jnp.asarray(3, jnp.int32))
+    rng = (np.arange(8, dtype=np.uint32),)
+    target = save_job_snapshot(
+        str(tmp_path),
+        "job-a",
+        {"model": model, "rng": rng},
+        epoch=4,
+        criteria=0.125,
+        specs={"model": ("replicated", "replicated", "replicated"), "rng": "host"},
+        meta={"numBatches": 7, "streamOffset": 4},
+    )
+    assert os.path.basename(target) == "snap-job-a.npz"
+
+    template = (jnp.zeros(6, jnp.float32), np.zeros(2), jnp.asarray(0, jnp.int32))
+    snap = load_job_snapshot(str(tmp_path), "job-a", templates={"model": template})
+    assert snap is not None
+    assert (snap.epoch, snap.criteria) == (4, 0.125)
+    assert snap.meta == {"numBatches": 7, "streamOffset": 4}
+    assert snap.specs["rng"] == ("host",)
+    c, f64, e = snap.sections["model"]
+    np.testing.assert_array_equal(c, np.arange(6, dtype=np.float32))
+    assert f64.dtype == np.float64  # cast back to the template's dtype
+    np.testing.assert_array_equal(f64, [1.5, -2.5])
+    assert int(e) == 3
+    # untemplated section comes back as a flat leaf list
+    np.testing.assert_array_equal(snap.sections["rng"][0], rng[0])
+
+
+def test_save_gathers_device_leaves_in_one_sync(tmp_path):
+    from flink_ml_tpu.utils import metrics
+
+    jnp = _jnp()
+    before = metrics.get_counter("iteration.host_sync.checkpoint")
+    save_job_snapshot(
+        str(tmp_path), "k", {"model": (jnp.zeros(4), jnp.ones(3))}, epoch=1
+    )
+    assert metrics.get_counter("iteration.host_sync.checkpoint") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# atomicity: torn writes
+# ---------------------------------------------------------------------------
+
+def test_torn_save_leaves_previous_snapshot_intact(tmp_path):
+    jnp = _jnp()
+    template = jnp.zeros(5)
+    save_job_snapshot(str(tmp_path), "j", {"model": jnp.arange(5.0)}, epoch=1)
+
+    with faults.inject("snapshot.write"):
+        with pytest.raises(InjectedFault):
+            save_job_snapshot(
+                str(tmp_path), "j", {"model": jnp.arange(5.0) * 10}, epoch=2
+            )
+    snap = load_job_snapshot(str(tmp_path), "j", templates={"model": template})
+    assert snap.epoch == 1  # the committed snapshot, not the torn one
+    np.testing.assert_array_equal(snap.sections["model"], np.arange(5.0, dtype=np.float32))
+
+    # the writer recovers: the next save overwrites the stale temp file
+    save_job_snapshot(str(tmp_path), "j", {"model": jnp.arange(5.0) * 10}, epoch=2)
+    snap = load_job_snapshot(str(tmp_path), "j", templates={"model": template})
+    assert snap.epoch == 2
+    np.testing.assert_array_equal(
+        snap.sections["model"], 10 * np.arange(5.0, dtype=np.float32)
+    )
+
+
+def test_kill_during_snapshot_save_resumes_from_previous(tmp_path):
+    """Satellite: a fit killed DURING a snapshot write (after the temp
+    file, before the atomic rename) resumes from the previous epoch's
+    snapshot and still lands on the uninterrupted run's exact model."""
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X @ np.linspace(1, -1, 6) > 0).astype(np.float32)
+
+    def fit(ckpt=None):
+        sgd = SGD(
+            max_iter=12, global_batch_size=100, tol=0.0,
+            checkpoint_dir=ckpt, checkpoint_key="torn",
+        )
+        return sgd.optimize(np.zeros(6), X, y, None, BINARY_LOGISTIC_LOSS)
+
+    ckpt = str(tmp_path / "ckpt")
+    expected, _, _ = fit(ckpt)  # uninterrupted reference (chunked layout)
+    os.remove(snapshot_file(ckpt, "torn"))
+
+    with faults.inject("snapshot.write", after=5):
+        with pytest.raises(InjectedFault):
+            fit(ckpt)
+    # epoch-5's write tore; epoch 4's snapshot must still be restorable
+    import jax.numpy as jnp
+
+    template = (jnp.zeros(6), jnp.zeros(6), jnp.asarray(0.0), jnp.asarray(0))
+    snap = load_job_snapshot(ckpt, "torn", templates={"model": template})
+    assert snap is not None and snap.epoch == 4
+
+    resumed, _, epochs = fit(ckpt)
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(resumed), np.asarray(expected))
+
+
+# ---------------------------------------------------------------------------
+# guards: versioning, structure, meta cursors
+# ---------------------------------------------------------------------------
+
+def _rewrite_manifest(file, mutate):
+    with np.load(file) as f:
+        arrays = {k: f[k] for k in f.files}
+    manifest = json.loads(str(arrays.pop("manifest")))
+    mutate(manifest)
+    np.savez(file, manifest=np.asarray(json.dumps(manifest)), **arrays)
+
+
+def test_future_format_version_refused(tmp_path):
+    jnp = _jnp()
+    file = save_job_snapshot(str(tmp_path), "v", {"model": jnp.zeros(3)}, epoch=2)
+    _rewrite_manifest(file, lambda m: m.update(version=99))
+    with pytest.warns(UserWarning, match="format version 99"):
+        snap = load_job_snapshot(str(tmp_path), "v", templates={"model": jnp.zeros(3)})
+    assert snap is None
+
+
+def test_foreign_structure_refused(tmp_path):
+    jnp = _jnp()
+    save_job_snapshot(str(tmp_path), "s", {"model": jnp.zeros(4)}, epoch=1)
+    with pytest.warns(UserWarning, match="structurally incompatible"):
+        snap = load_job_snapshot(str(tmp_path), "s", templates={"model": jnp.zeros(5)})
+    assert snap is None
+
+
+def test_meta_cursor_mismatch_refused(tmp_path):
+    jnp = _jnp()
+    save_job_snapshot(
+        str(tmp_path), "m", {"model": jnp.zeros(4)}, epoch=1, meta={"numBatches": 10}
+    )
+    with pytest.warns(UserWarning, match="numBatches"):
+        snap = load_job_snapshot(
+            str(tmp_path),
+            "m",
+            templates={"model": jnp.zeros(4)},
+            expect_meta={"numBatches": 7},
+        )
+    assert snap is None
+    # matching cursors restore fine
+    snap = load_job_snapshot(
+        str(tmp_path),
+        "m",
+        templates={"model": jnp.zeros(4)},
+        expect_meta={"numBatches": 10},
+    )
+    assert snap is not None
+
+
+def test_unkeyed_restore_warns_keyed_does_not(tmp_path):
+    jnp = _jnp()
+    save_job_snapshot(str(tmp_path), None, {"model": jnp.zeros(2)}, epoch=1)
+    with pytest.warns(UserWarning, match="un-keyed"):
+        assert load_job_snapshot(str(tmp_path), None, templates={"model": jnp.zeros(2)})
+    save_job_snapshot(str(tmp_path), "keyed", {"model": jnp.zeros(2)}, epoch=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert load_job_snapshot(
+            str(tmp_path), "keyed", templates={"model": jnp.zeros(2)}
+        )
+
+
+# ---------------------------------------------------------------------------
+# legacy migration (one-way)
+# ---------------------------------------------------------------------------
+
+def test_legacy_checkpoint_reads_through_snapshot_loader(tmp_path):
+    from flink_ml_tpu.parallel.iteration import save_iteration_checkpoint
+
+    jnp = _jnp()
+    carry = (jnp.asarray([1.0, 2.0]), jnp.asarray(7, jnp.int32))
+    save_iteration_checkpoint(str(tmp_path), carry, epoch=3, criteria=0.5, job_key="lg")
+    snap = load_job_snapshot(str(tmp_path), "lg", templates={"model": carry})
+    assert snap is not None
+    assert (snap.epoch, snap.criteria) == (3, 0.5)
+    assert snap.version == 0  # pre-JobSnapshot
+    assert snap.meta["migratedFrom"].startswith("ckpt-")
+    np.testing.assert_array_equal(snap.sections["model"][0], [1.0, 2.0])
+
+
+def test_legacy_sgd_checkpoint_resumes_and_migrates(tmp_path):
+    """A checkpoint_dir left behind by the pre-JobSnapshot carry-only
+    writer resumes (instead of restarting) and the resumed run's next
+    save writes the NEW format — one-way migration."""
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.parallel.iteration import save_iteration_checkpoint
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X @ np.linspace(-1, 1, 6) > 0).astype(np.float32)
+
+    def fit(ckpt, max_iter):
+        sgd = SGD(
+            max_iter=max_iter, global_batch_size=100, tol=0.0,
+            checkpoint_dir=ckpt, checkpoint_key="mig",
+        )
+        return sgd.optimize(np.zeros(6), X, y, None, BINARY_LOGISTIC_LOSS)
+
+    ref_dir = str(tmp_path / "ref")
+    expected, _, _ = fit(ref_dir, 15)
+
+    # emulate the legacy layout: run to epoch 6, convert the snapshot to
+    # the old carry-only file, and delete the new-format file
+    leg_dir = str(tmp_path / "legacy")
+    fit(leg_dir, 6)
+    import jax.numpy as jnp
+
+    template = (jnp.zeros(6), jnp.zeros(6), jnp.asarray(0.0), jnp.asarray(0))
+    snap = load_job_snapshot(leg_dir, "mig", templates={"model": template})
+    assert snap.epoch == 6
+    save_iteration_checkpoint(
+        leg_dir, snap.sections["model"], snap.epoch, snap.criteria, "mig"
+    )
+    os.remove(snapshot_file(leg_dir, "mig"))
+
+    resumed, _, epochs = fit(leg_dir, 15)
+    assert epochs == 15
+    np.testing.assert_array_equal(np.asarray(resumed), np.asarray(expected))
+    assert os.path.exists(snapshot_file(leg_dir, "mig"))  # migrated forward
+
+
+# ---------------------------------------------------------------------------
+# elastic re-staging
+# ---------------------------------------------------------------------------
+
+def test_stage_section_reshards_onto_other_meshes(tmp_path):
+    import jax
+
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    jnp = _jnp()
+    coeff = jnp.arange(16.0)
+    rows = jnp.arange(32.0).reshape(8, 4)
+    save_job_snapshot(
+        str(tmp_path),
+        "el",
+        {"model": (coeff, rows, np.float64(2.0))},
+        epoch=1,
+        specs={"model": ("replicated", "data", "host")},
+    )
+    snap = load_job_snapshot(
+        str(tmp_path),
+        "el",
+        templates={"model": (jnp.zeros(16), jnp.zeros((8, 4)), np.float64(0))},
+    )
+    for n_dev in (1, 2, 8):
+        mesh = mesh_lib.create_mesh(("data",), devices=jax.devices()[:n_dev])
+        c, r, host_leaf = stage_section(snap, "model", mesh=mesh)
+        assert isinstance(c, jax.Array) and isinstance(r, jax.Array)
+        assert c.sharding.mesh.shape["data"] == n_dev
+        assert c.sharding.spec == mesh_lib.replicated_sharding(mesh).spec
+        assert r.sharding.spec == mesh_lib.data_sharding(mesh, 2).spec
+        np.testing.assert_array_equal(np.asarray(c), np.arange(16.0, dtype=np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(r), np.arange(32.0, dtype=np.float32).reshape(8, 4)
+        )
+        assert isinstance(host_leaf, np.ndarray)  # "host" tag stays off-device
+        assert float(host_leaf) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_counters_and_spans(tmp_path):
+    from flink_ml_tpu.obs import tracing
+    from flink_ml_tpu.utils import metrics
+
+    jnp = _jnp()
+    count0 = metrics.get_counter("checkpoint.count")
+    bytes0 = metrics.get_counter("checkpoint.bytes")
+    restore0 = metrics.get_counter("checkpoint.restore.count")
+    tracing.configure(ring_size=64)
+    try:
+        save_job_snapshot(str(tmp_path), "obs", {"model": jnp.zeros(8)}, epoch=1)
+        assert load_job_snapshot(
+            str(tmp_path), "obs", templates={"model": jnp.zeros(8)}
+        )
+        names = [r["name"] for r in tracing.drain_ring()]
+    finally:
+        tracing.configure()
+    assert "checkpoint.save" in names
+    assert "checkpoint.restore" in names
+    assert metrics.get_counter("checkpoint.count") == count0 + 1
+    assert metrics.get_counter("checkpoint.bytes") == bytes0 + 8 * 4
+    assert metrics.get_counter("checkpoint.restore.count") == restore0 + 1
