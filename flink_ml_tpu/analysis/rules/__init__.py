@@ -1,0 +1,11 @@
+"""tpulint rule modules. Importing this package registers every rule with
+the engine registry (flink_ml_tpu.analysis.engine)."""
+
+from . import (  # noqa: F401
+    accounting,
+    coverage,
+    donation,
+    hostsync,
+    retrace,
+    shardingtags,
+)

@@ -1,0 +1,207 @@
+"""Cross-module index of jit kernels, module aliases, and donation info.
+
+Several rules need the same syntactic facts about a module:
+
+- which local names are bound to numpy / jax / jax.numpy / jax.lax,
+- which local names are jitted kernels (``N = lazy_jit(f)`` /
+  ``N = jax.jit(f)`` / ``@jax.jit``-decorated defs), which are keyed
+  factories (``N = keyed_jit(make)``), and which of those kernels donate
+  which positional arguments,
+- which imported names resolve to kernels defined in sibling modules
+  (e.g. ``from ..ops.distance import jit_find_closest``).
+
+This module builds that index once per project (memoized via
+``Project.index``) so the retrace, donation-after-use, and host-sync
+rules stay small and agree with each other.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+from ..source import SourceModule, dotted_name, resolve_relative_import
+
+LAZYJIT_MODULE = "flink_ml_tpu.utils.lazyjit"
+
+# dotted prefixes of jax namespaces whose calls produce device arrays
+DEVICE_NAMESPACE_SUFFIXES = ("numpy", "nn", "lax", "random")
+
+
+@dataclass
+class ModuleJitInfo:
+    path: str
+    module_name: str
+    np_aliases: Set[str] = field(default_factory=set)
+    jax_aliases: Set[str] = field(default_factory=set)
+    jnp_aliases: Set[str] = field(default_factory=set)  # jax.numpy / jax.nn / ...
+    lax_aliases: Set[str] = field(default_factory=set)
+    lazy_jit_names: Set[str] = field(default_factory=set)  # bound to lazy_jit
+    keyed_jit_names: Set[str] = field(default_factory=set)  # bound to keyed_jit
+    # kernel name -> donated positional argument indices (empty = borrows)
+    kernels: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    factories: Set[str] = field(default_factory=set)  # keyed_jit factories
+    # imported name -> (module dotted path, original name) for later linking
+    imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def is_jit_callable(self, node: ast.AST) -> bool:
+        """Does this expression denote a jit entry point (jax.jit or a
+        lazyjit helper)?"""
+        name = dotted_name(node)
+        if name is None:
+            return False
+        root, _, rest = name.partition(".")
+        if root in self.jax_aliases and rest == "jit":
+            return True
+        return name in self.lazy_jit_names or name in self.keyed_jit_names
+
+    def device_namespace_call(self, func: ast.AST) -> bool:
+        """Is ``func`` a call target in a device-array-producing jax
+        namespace (jnp.*, lax.*, jax.nn.*, jax.numpy.*, jax.random.*)?"""
+        name = dotted_name(func)
+        if name is None:
+            return False
+        root, _, rest = name.partition(".")
+        if not rest:
+            return False
+        if root in self.jnp_aliases or root in self.lax_aliases:
+            return True
+        if root in self.jax_aliases:
+            first = rest.split(".")[0]
+            return first in DEVICE_NAMESPACE_SUFFIXES
+        return False
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            value = kw.value
+            if isinstance(value, ast.Constant) and isinstance(value.value, int):
+                return (value.value,)
+            if isinstance(value, (ast.Tuple, ast.List)):
+                out = []
+                for elt in value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+    return ()
+
+
+def _jit_call_kind(info: ModuleJitInfo, node: ast.AST) -> Optional[str]:
+    """'kernel' / 'factory' if ``node`` is a jit-wrapper construction."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        # functools.partial(jax.jit, ...) used as a decorator
+        return None
+    root, _, rest = name.partition(".")
+    if root in info.jax_aliases and rest == "jit":
+        return "kernel"
+    if name in info.lazy_jit_names:
+        return "kernel"
+    if name in info.keyed_jit_names:
+        return "factory"
+    return None
+
+
+def _partial_jit_call(info: ModuleJitInfo, node: ast.AST) -> Optional[ast.Call]:
+    """``partial(jax.jit, ...)`` / ``partial(lazy_jit, ...)`` -> the Call."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name not in ("partial", "functools.partial"):
+        return None
+    if node.args and info.is_jit_callable(node.args[0]):
+        return node
+    return None
+
+
+def build_module_info(module: SourceModule) -> ModuleJitInfo:
+    info = ModuleJitInfo(path=module.path, module_name=module.module_name)
+    if module.tree is None:
+        return info
+
+    # pass 1: imports / aliases
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if alias.name == "numpy":
+                    info.np_aliases.add(bound)
+                elif alias.name == "jax":
+                    info.jax_aliases.add(bound)
+                elif alias.name == "jax.numpy" and alias.asname:
+                    info.jnp_aliases.add(alias.asname)
+                elif alias.name == "jax.lax" and alias.asname:
+                    info.lax_aliases.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom):
+            target = resolve_relative_import(
+                module.module_name, node, module.is_package
+            )
+            if target is None:
+                continue
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if target == "jax":
+                    if alias.name == "numpy":
+                        info.jnp_aliases.add(bound)
+                    elif alias.name == "lax":
+                        info.lax_aliases.add(bound)
+                elif target == "jax.numpy":
+                    info.jnp_aliases.add(bound)  # symbol import; treated as ns
+                elif target == LAZYJIT_MODULE or target.endswith("utils.lazyjit"):
+                    if alias.name == "lazy_jit":
+                        info.lazy_jit_names.add(bound)
+                    elif alias.name == "keyed_jit":
+                        info.keyed_jit_names.add(bound)
+                info.imports[bound] = (target, alias.name)
+
+    # pass 2: module-level kernel bindings and jit-decorated defs
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            kind = _jit_call_kind(info, node.value)
+            if kind == "kernel":
+                info.kernels[target.id] = _donate_positions(node.value)
+            elif kind == "factory":
+                info.factories.add(target.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if info.is_jit_callable(dec):
+                    info.kernels.setdefault(node.name, ())
+                    break
+                partial_call = _partial_jit_call(info, dec)
+                if partial_call is not None:
+                    info.kernels[node.name] = _donate_positions(partial_call)
+                    break
+    return info
+
+
+def build_index(project) -> Dict[str, ModuleJitInfo]:
+    """path -> ModuleJitInfo with imported kernels linked across modules."""
+    by_path: Dict[str, ModuleJitInfo] = {}
+    by_module: Dict[str, ModuleJitInfo] = {}
+    for module in project.modules:
+        info = build_module_info(module)
+        by_path[module.path] = info
+        if module.module_name:
+            by_module[module.module_name] = info
+    # link imported kernels/factories (one hop is enough for this tree)
+    for info in by_path.values():
+        for bound, (target_module, original) in info.imports.items():
+            target = by_module.get(target_module)
+            if target is None:
+                continue
+            if original in target.kernels and bound not in info.kernels:
+                info.kernels[bound] = target.kernels[original]
+            if original in target.factories:
+                info.factories.add(bound)
+    return by_path
+
+
+def jit_index(project) -> Dict[str, ModuleJitInfo]:
+    return project.index("jitindex", build_index)

@@ -1,0 +1,109 @@
+"""Accounting gates: raw collectives / raw transfers in models and ops.
+
+These are the two oldest tpulint rules, ported from the standalone
+``scripts/check_collective_accounting.py`` and
+``scripts/check_upload_accounting.py`` gates (which remain as thin shims
+over these rules). Both enforce the same economic invariant: a byte that
+moves without being counted makes every BENCH field that sums bytes a
+lie. Scanning is over the comment/string-stripped source (the shared
+``analysis.source.code_only``), so docstrings that merely *mention* a
+primitive stay legal.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from ..engine import Finding, Rule, register
+from ..source import SourceModule
+
+# the surfaces the accounted wrappers cover (keep in sync with
+# parallel/collectives.py and parallel/prefetch.py)
+COLLECTIVE_PRIMITIVES = (
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+)
+TRANSFER_PRIMITIVES = (
+    "device_put",
+    "device_put_sharded",
+    "device_put_replicated",
+    "make_array_from_callback",
+    "make_array_from_single_device_arrays",
+)
+
+
+class _PatternRule(Rule):
+    """Regex-over-stripped-source rule; findings carry the matched
+    primitive in ``data`` for the legacy shims."""
+
+    pattern: re.Pattern = None  # type: ignore[assignment]
+    message_fmt: str = ""
+
+    def check_module(
+        self, project, module: SourceModule
+    ) -> Iterable[Finding]:
+        for i, line in enumerate(module.stripped_lines(), start=1):
+            for match in self.pattern.finditer(line):
+                primitive = match.group(1)
+                yield Finding(
+                    path=module.path,
+                    line=i,
+                    rule=self.id,
+                    message=self.message_fmt.format(primitive=primitive),
+                    data=(primitive,),
+                )
+
+
+@register
+class CollectiveAccountingRule(_PatternRule):
+    id = "collective-accounting"
+    title = "raw lax collective bypasses the accounted wrappers"
+    rationale = (
+        "Every collective a model or op dispatches must ride the accounted "
+        "wrappers in parallel/collectives.py — that is what keeps the "
+        "`collective.*` counters (and the BENCH `collectiveBreakdown` "
+        "field) an exhaustive answer to 'what traffic does this program "
+        "move'. A raw `lax.psum` would execute fine and silently vanish "
+        "from the accounting. GSPMD-inserted collectives are invisible to "
+        "source scanning and intentionally out of scope."
+    )
+    example = "grad = lax.psum(grad, axis_name)  # use collectives.all_reduce_sum"
+    scope = ("flink_ml_tpu/models", "flink_ml_tpu/ops")
+    pattern = re.compile(
+        r"\blax\s*\.\s*(" + "|".join(COLLECTIVE_PRIMITIVES) + r")\s*\("
+    )
+    message_fmt = (
+        "lax.{primitive}(...) bypasses the accounted collective wrappers "
+        "(use flink_ml_tpu.parallel.collectives instead)"
+    )
+
+
+@register
+class UploadAccountingRule(_PatternRule):
+    id = "upload-accounting"
+    title = "raw host->device transfer bypasses the accounted stager"
+    rationale = (
+        "Every host->device upload a model or op makes must ride the "
+        "accounted stager in parallel/prefetch.py (`stage_to_device` / "
+        "`stage_from_callback`) — that is what keeps `h2d.bytes`/`h2d.count` "
+        "(and the BENCH `h2dBytes` field, and the inputPipeline entry's "
+        "zero-upload-epochs claim) exhaustive. The upload-side mirror of "
+        "collective-accounting; implicit jit-argument transfers are out of "
+        "scope — the bulk data paths all stage explicitly."
+    )
+    example = "X_dev = jax.device_put(X)  # use prefetch.stage_to_device"
+    scope = ("flink_ml_tpu/models", "flink_ml_tpu/ops")
+    pattern = re.compile(
+        r"\bjax\s*\.\s*(" + "|".join(TRANSFER_PRIMITIVES) + r")\s*\("
+    )
+    message_fmt = (
+        "jax.{primitive}(...) bypasses the accounted host->device stager "
+        "(use flink_ml_tpu.parallel.prefetch.stage_to_device instead)"
+    )

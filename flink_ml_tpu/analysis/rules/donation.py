@@ -1,0 +1,168 @@
+"""donation-after-use: reading a buffer after donating it.
+
+The ``supports_donation()``-gated kernel variants (``*_donating``,
+``donate_argnums=...``) let XLA reuse an argument's HBM in place — after
+the call the Python name still points at a buffer whose contents are
+gone. On CPU donation is a silent no-op, so a read-after-donate bug
+passes every CPU test and corrupts results only on the TPU backend:
+exactly the class of hazard that must be held statically.
+
+The rule resolves donating kernels from the shared jit index (module
+level ``N = jax.jit(f, donate_argnums=...)`` bindings, ``@partial(jax.jit,
+donate_argnums=...)`` decorators, and one-hop imports of either), follows
+the repo's selection idiom
+
+    step = _sgd_chunk_donating if donate_ok else _sgd_chunk
+
+and then walks each function linearly: a plain-name argument in a donated
+position is dead after the call statement; any later load of that name
+before a rebind is a finding. Donated names rebound by the call statement
+itself (the ping-pong carry idiom) are fine. Calls with ``*args`` before
+a donated position are skipped — positions are unknowable statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, Rule, register
+from ..source import SourceModule
+from . import _jitindex
+from ._astwalk import header_nodes as _header_nodes
+from ._astwalk import statements_in_order
+
+
+def _donating_alias(
+    kernels: Dict[str, Tuple[int, ...]], value: ast.AST
+) -> Optional[Tuple[int, ...]]:
+    """Donated positions if ``value`` may evaluate to a donating kernel
+    (a bare name, or either arm of the donation-gating IfExp idiom)."""
+    if isinstance(value, ast.Name):
+        positions = kernels.get(value.id, ())
+        return positions or None
+    if isinstance(value, ast.IfExp):
+        for arm in (value.body, value.orelse):
+            positions = _donating_alias(kernels, arm)
+            if positions:
+                return positions
+    return None
+
+
+def _stored_names(stmt: ast.stmt) -> Set[str]:
+    out = set()
+    for header in _header_nodes(stmt):
+        for sub in ast.walk(header):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                out.add(sub.id)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        out.add(stmt.name)
+    return out
+
+
+def _loaded_names_with_lines(stmt: ast.stmt) -> List[Tuple[str, int]]:
+    out = []
+    for header in _header_nodes(stmt):
+        for sub in ast.walk(header):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                out.append((sub.id, sub.lineno))
+    return out
+
+
+@register
+class DonationAfterUseRule(Rule):
+    id = "donation-after-use"
+    title = "donated buffer read after the donating call"
+    rationale = (
+        "donate_argnums hands the argument's HBM to XLA for in-place "
+        "reuse; the Python name then references freed storage. CPU "
+        "backends ignore donation, so the bug is invisible to CPU tests "
+        "and real on TPU — reads after a donating call must either use "
+        "the call's results or re-materialize the value first."
+    )
+    example = (
+        "carry2, _ = _sgd_chunk_donating(X, y, w, carry, crit, ...)\n"
+        "loss_of(carry)  # carry was donated (argnum 3): buffer is gone"
+    )
+    scope = ("flink_ml_tpu",)
+
+    def check_module(
+        self, project, module: SourceModule
+    ) -> Iterable[Finding]:
+        if module.tree is None:
+            return ()
+        info = _jitindex.jit_index(project)[module.path]
+        donating = {n: p for n, p in info.kernels.items() if p}
+        if not donating:
+            return ()
+        findings: List[Finding] = []
+        for func in ast.walk(module.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._check_function(module, donating, func))
+        # module-level statements can call kernels too
+        findings.extend(
+            self._check_statements(module, donating, {}, module.tree.body)
+        )
+        return findings
+
+    def _check_function(self, module, donating, func):
+        return self._check_statements(module, donating, {}, func.body)
+
+    def _check_statements(self, module, donating, aliases, body):
+        """Linear walk: track donating-kernel aliases, poison donated
+        names, report loads of poisoned names, clear on rebind."""
+        statements = statements_in_order(body)
+        aliases = dict(aliases)
+        poisoned: Dict[str, Tuple[str, int]] = {}  # name -> (kernel, line)
+        findings: List[Finding] = []
+        for stmt in statements:
+            # loads first (x = f(x) reads before it writes)
+            for name, line in _loaded_names_with_lines(stmt):
+                hit = poisoned.get(name)
+                if hit is not None:
+                    kernel, donated_at = hit
+                    findings.append(
+                        Finding(
+                            path=module.path,
+                            line=line,
+                            rule=self.id,
+                            message=(
+                                f"'{name}' was donated to {kernel} on line "
+                                f"{donated_at} — its buffer may be reused "
+                                "in place; use the call's results or "
+                                "re-materialize before reading"
+                            ),
+                            data=(name, kernel),
+                        )
+                    )
+                    del poisoned[name]  # one report per donation site
+            # alias tracking (step = _x_donating if ok else _x)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    positions = _donating_alias(donating, stmt.value)
+                    if positions:
+                        aliases[target.id] = positions
+                    elif target.id in aliases:
+                        del aliases[target.id]
+            # donation: any call to a donating kernel (or alias) in stmt
+            calls = [
+                sub
+                for header in _header_nodes(stmt)
+                for sub in ast.walk(header)
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+            ]
+            for sub in calls:
+                positions = donating.get(sub.func.id) or aliases.get(sub.func.id)
+                if not positions:
+                    continue
+                if any(isinstance(a, ast.Starred) for a in sub.args):
+                    continue  # positions unknowable statically
+                for pos in positions:
+                    if pos < len(sub.args) and isinstance(sub.args[pos], ast.Name):
+                        poisoned[sub.args[pos].id] = (sub.func.id, sub.lineno)
+            # rebinds clear the poison (after the call in the same stmt)
+            for name in _stored_names(stmt):
+                poisoned.pop(name, None)
+        return findings

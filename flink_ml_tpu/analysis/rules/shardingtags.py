@@ -1,0 +1,219 @@
+"""sharding-tags: checkpoint spec tags must be stageable on a mesh.
+
+A JobSnapshot leaf carries a sharding-spec tag (``replicated`` / ``data``
+/ ``model`` / ``host``) that ``ckpt/snapshot.py:stage_section`` resolves
+against ``parallel/mesh.py``'s spec constructors at RESTORE time — which
+is the worst possible moment to discover a typo: the fit that wrote the
+snapshot is gone, and the resume (possibly on a different device count;
+that is the elastic contract) refuses the file. This rule checks the
+consistency chain statically, at the lint step:
+
+1. the literal tag table (``_SPEC_TAGS`` in snapshot.py) is the single
+   source of truth;
+2. every non-host tag in it must have a ``<tag>_sharding`` constructor in
+   parallel/mesh.py AND be dispatched by snapshot.py's ``_sharding_for``;
+3. every literal tag at a ``save_job_snapshot(..., specs=...)`` /
+   ``stage_section(..., specs=...)`` call site anywhere in the package
+   must name a tag from the table (dict KEYS are section names and are
+   not checked; simple local-variable indirection — the ``carry_specs``
+   idiom — is followed one assignment deep).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..engine import Finding, Rule, register
+from ..source import SourceModule, dotted_name
+
+SNAPSHOT_PATH = "flink_ml_tpu/ckpt/snapshot.py"
+MESH_PATH = "flink_ml_tpu/parallel/mesh.py"
+SPEC_TABLE_NAME = "_SPEC_TAGS"
+# "host" leaves stay numpy — staged by identity, no mesh constructor
+NON_MESH_TAGS = {"host"}
+ENTRY_POINTS = ("save_job_snapshot", "stage_section")
+
+
+def _literal_strings(node: ast.AST) -> Iterable[Tuple[str, int]]:
+    """(string, line) for every literal tag inside a specs expression —
+    skipping dict KEYS (they are section names, not tags)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            yield node.value, node.lineno
+    elif isinstance(node, ast.Dict):
+        for value in node.values:
+            yield from _literal_strings(value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            yield from _literal_strings(elt)
+    elif isinstance(node, ast.BinOp):
+        # ("replicated",) * len(x) and friends
+        yield from _literal_strings(node.left)
+        yield from _literal_strings(node.right)
+    elif isinstance(node, ast.IfExp):
+        yield from _literal_strings(node.body)
+        yield from _literal_strings(node.orelse)
+    elif isinstance(node, ast.Starred):
+        yield from _literal_strings(node.value)
+
+
+def _spec_table(snapshot_module: SourceModule) -> Tuple[Set[str], int]:
+    """The _SPEC_TAGS literals and the line they are declared on."""
+    if snapshot_module.tree is None:
+        return set(), 1
+    for node in snapshot_module.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == SPEC_TABLE_NAME
+        ):
+            tags = {s for s, _ in _literal_strings(node.value)}
+            return tags, node.lineno
+    return set(), 1
+
+
+def _dispatched_tags(snapshot_module: SourceModule) -> Set[str]:
+    """Tags `_sharding_for` explicitly compares against (its trailing
+    return is the replicated fallback)."""
+    tags: Set[str] = set()
+    if snapshot_module.tree is None:
+        return tags
+    for node in ast.walk(snapshot_module.tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "_sharding_for"
+        ):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Compare):
+                    for comp in [sub.left] + list(sub.comparators):
+                        if isinstance(comp, ast.Constant) and isinstance(
+                            comp.value, str
+                        ):
+                            tags.add(comp.value)
+    return tags
+
+
+def _mesh_constructors(mesh_module: SourceModule) -> Set[str]:
+    """Tags for which parallel/mesh.py defines `<tag>_sharding`."""
+    out: Set[str] = set()
+    if mesh_module.tree is None:
+        return out
+    for node in mesh_module.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name.endswith("_sharding"):
+            out.add(node.name[: -len("_sharding")])
+    return out
+
+
+@register
+class ShardingTagRule(Rule):
+    id = "sharding-tags"
+    title = "checkpoint sharding tag is not stageable"
+    rationale = (
+        "Snapshot leaf tags are resolved against parallel/mesh.py's spec "
+        "constructors at RESTORE time — a tag the mesh cannot stage turns "
+        "a recoverable preemption into an unrecoverable refusal, "
+        "discovered only when the original fit is already gone. The tag "
+        "table, the stage_section dispatch, the mesh constructors, and "
+        "every literal tag at a save/stage call site must agree."
+    )
+    example = 'save_job_snapshot(..., specs={"model": "fully_sharded"})'
+    scope = ("flink_ml_tpu",)
+
+    def check_project(self, project) -> Iterable[Finding]:
+        snapshot_module = project.module_at(SNAPSHOT_PATH)
+        mesh_module = project.module_at(MESH_PATH)
+        if snapshot_module is None or mesh_module is None:
+            return  # subsystem absent; nothing to hold consistent
+        tags, table_line = _spec_table(snapshot_module)
+        if not tags:
+            yield Finding(
+                path=SNAPSHOT_PATH,
+                line=table_line,
+                rule=self.id,
+                message=(
+                    f"cannot locate the literal {SPEC_TABLE_NAME} spec table "
+                    "— the sharding-tag consistency chain is unanchored"
+                ),
+            )
+            return
+
+        dispatched = _dispatched_tags(snapshot_module) | {"replicated"}
+        constructors = _mesh_constructors(mesh_module) | NON_MESH_TAGS
+        for tag in sorted(tags):
+            if tag not in constructors:
+                yield Finding(
+                    path=MESH_PATH,
+                    line=1,
+                    rule=self.id,
+                    message=(
+                        f"spec tag {tag!r} (ckpt/snapshot.py {SPEC_TABLE_NAME}) "
+                        f"has no {tag}_sharding constructor in parallel/mesh.py "
+                        "— stage_section cannot resolve it on any mesh"
+                    ),
+                    data=(tag,),
+                )
+            if tag not in dispatched and tag not in NON_MESH_TAGS:
+                yield Finding(
+                    path=SNAPSHOT_PATH,
+                    line=table_line,
+                    rule=self.id,
+                    message=(
+                        f"spec tag {tag!r} is in {SPEC_TABLE_NAME} but "
+                        "_sharding_for never dispatches it — restores would "
+                        "silently fall back to replicated"
+                    ),
+                    data=(tag,),
+                )
+
+        # call sites across the package
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            yield from self._check_call_sites(module, tags)
+
+    def _check_call_sites(
+        self, module: SourceModule, tags: Set[str]
+    ) -> Iterable[Finding]:
+        # simple one-deep local indirection: name -> literal tags
+        local_literals: Dict[str, List[Tuple[str, int]]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if isinstance(target, ast.Name):
+                    found = list(_literal_strings(node.value))
+                    if found:
+                        local_literals[target.id] = found
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in ENTRY_POINTS:
+                continue
+            for kw in node.keywords:
+                if kw.arg != "specs":
+                    continue
+                value = kw.value
+                candidates = list(_literal_strings(value))
+                if isinstance(value, ast.Name):
+                    candidates = local_literals.get(value.id, [])
+                elif isinstance(value, ast.Dict):
+                    # dict values may themselves be local names
+                    for v in value.values:
+                        if isinstance(v, ast.Name):
+                            candidates += local_literals.get(v.id, [])
+                for tag, line in candidates:
+                    if tag not in tags:
+                        yield Finding(
+                            path=module.path,
+                            line=line,
+                            rule=self.id,
+                            message=(
+                                f"unknown sharding-spec tag {tag!r} — "
+                                f"not in ckpt/snapshot.py {SPEC_TABLE_NAME} "
+                                f"({', '.join(sorted(tags))}); stage_section "
+                                "would refuse this snapshot at restore time"
+                            ),
+                            data=(tag,),
+                        )

@@ -18,6 +18,7 @@ import numpy as np
 from ..common.param import HasSeed
 from ..param import IntParam, LongParam, Param, ParamValidators
 from ..table import DictTokenMatrix, Table
+from ..utils.lazyjit import lazy_jit
 
 # Rows at or above this threshold are generated directly in device HBM with
 # jax.random — the analogue of the reference generating data *inside* the
@@ -55,31 +56,34 @@ def _device_gen_enabled() -> bool:
     return os.environ.get("FLINK_ML_TPU_DEVICE_DATAGEN", "1") != "0"
 
 
-_device_gen_fns = {}
+def _uniform_impl(key, shape):
+    import jax
+
+    return jax.random.uniform(key, shape, dtype=jax.numpy.float32)
+
+
+def _randint_float_impl(key, shape, arity):
+    import jax
+
+    return jax.random.randint(key, shape, 0, arity).astype(jax.numpy.float32)
+
+
+# one compiled program per shape (static_argnames); lazy_jit keeps the
+# wrappers on the jit.kernels accounting like every other kernel
+_uniform_kernel = lazy_jit(_uniform_impl, static_argnames=("shape",))
+_randint_kernel = lazy_jit(_randint_float_impl, static_argnames=("shape", "arity"))
 
 
 def _device_uniform(seed: int, shape):
     import jax
 
-    if "uniform" not in _device_gen_fns:  # one compiled program per shape
-        _device_gen_fns["uniform"] = jax.jit(
-            lambda key, shape: jax.random.uniform(key, shape, dtype=jax.numpy.float32),
-            static_argnames=("shape",),
-        )
-    return _device_gen_fns["uniform"](jax.random.PRNGKey(seed), tuple(shape))
+    return _uniform_kernel(jax.random.PRNGKey(seed), tuple(shape))
 
 
 def _device_randint_float(seed: int, shape, arity: int):
     import jax
 
-    if "randint" not in _device_gen_fns:
-        _device_gen_fns["randint"] = jax.jit(
-            lambda key, shape, arity: jax.random.randint(key, shape, 0, arity).astype(
-                jax.numpy.float32
-            ),
-            static_argnames=("shape", "arity"),
-        )
-    return _device_gen_fns["randint"](jax.random.PRNGKey(seed), tuple(shape), int(arity))
+    return _randint_kernel(jax.random.PRNGKey(seed), tuple(shape), int(arity))
 
 
 class _ColNamesParam(Param):

@@ -248,6 +248,7 @@ def _block_until_ready(tables: List[Table]) -> None:
                     probes.append(arr[(0,) * arr.ndim].astype(jnp.float32))
     if probes:
         t0 = time.perf_counter()
+        # tpulint: disable=host-sync-leak -- this IS the timing barrier: one probe readback, accounted via account_readback below
         host = np.asarray(jnp.stack(probes))
         # the barrier is itself a readback — account it like any other
         tracing.account_readback(host.nbytes, time.perf_counter() - t0, len(probes))

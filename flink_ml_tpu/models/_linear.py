@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.losses import SPARSE_VARIANTS, LossFunc
+from ..utils.lazyjit import lazy_jit
 from ..ops.optimizer import SGD, read_train_result
 from ..table import SparseBatch, Table, as_dense_matrix
 
@@ -137,7 +138,7 @@ def run_sgd(
     return coeff, criteria, epochs
 
 
-@jax.jit
+@lazy_jit
 def sparse_raw_scores(indices, values, coeff):
     """Per-row dot of padded-CSR features with the coefficient — the sparse
     inference hot loop (LogisticRegressionModel.java:131), sharing the
@@ -167,7 +168,7 @@ def is_device_column(col) -> bool:
     return isinstance(col, jax.Array)
 
 
-@jax.jit
+@lazy_jit
 def _labels_ok(y):
     """Device-side {0,1} label check (LogisticRegression.java:78-87)."""
     return jnp.all((y == 0.0) | (y == 1.0)).astype(jnp.float32)
@@ -196,7 +197,9 @@ def validate_binomial_labels(y) -> None:
     classifiers (LogisticRegression.java:78-87). Device-resident labels are
     validated on device (one scalar readback, no bulk transfer)."""
     if isinstance(y, jax.Array):
-        ok = bool(_labels_ok(y))
+        from ..utils.packing import packed_device_get
+
+        ok = bool(packed_device_get(_labels_ok(y), sync_kind="fit")[0])
     else:
         ok = bool(np.all((y == 0.0) | (y == 1.0)))
     _raise_if_invalid(ok)

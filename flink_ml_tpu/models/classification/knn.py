@@ -21,6 +21,7 @@ from ...common.param import HasFeaturesCol, HasLabelCol, HasPredictionCol
 from ...param import IntParam, ParamValidators
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 from .._linear import is_device_column
 
@@ -39,13 +40,13 @@ class KnnParams(KnnModelParams, HasLabelCol):
     pass
 
 
-@jax.jit
+@lazy_jit
 def _gather_labels(labels, idx):
     """Module-level jit (an inline jit would recompile per transform)."""
     return labels[idx]
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(lazy_jit, static_argnames=("k",))
 def _top_k_indices(X_test, X_train, k):
     """Squared-euclidean pairwise distances -> top-k neighbor indices."""
     t2 = jnp.sum(X_test * X_test, axis=1, keepdims=True)
@@ -99,14 +100,16 @@ class KnnModel(Model, KnnModelParams):
         )
         # single readback either way; never pack int32 indices with float
         # labels (float32 promotion corrupts indices above 2**24)
+        from ...utils.packing import packed_device_get
+
         if is_device_column(self.labels):
-            neighbor_labels = np.asarray(
+            neighbor_labels = packed_device_get(
                 _gather_labels(jnp.asarray(self.labels), idx_dev),
-                dtype=np.float64,
-            )
+                sync_kind="transform",
+            )[0].astype(np.float64)
         else:
             neighbor_labels = np.asarray(self.labels, dtype=np.float64)[
-                np.asarray(idx_dev)
+                packed_device_get(idx_dev, sync_kind="transform")[0]
             ]
         pred = _majority_vote(neighbor_labels)
         return [table.with_column(self.get_prediction_col(), pred)]

@@ -31,6 +31,7 @@ from ...ops.losses import HINGE_LOSS
 from ...param import FloatParam
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 from .. import _linear
 
@@ -63,7 +64,7 @@ class LinearSVCParams(
     pass
 
 
-@jax.jit
+@lazy_jit
 def _predict_from_dot(dot, threshold):
     """prediction = dot >= threshold ? 1 : 0; rawPrediction = [dot, -dot]
     (LinearSVCModel.predictOneDataPoint:170-173)."""
@@ -72,7 +73,7 @@ def _predict_from_dot(dot, threshold):
     return pred, raw
 
 
-@jax.jit
+@lazy_jit
 def _predict(X, coeff, threshold):
     return _predict_from_dot(X @ coeff, threshold)
 
@@ -135,9 +136,14 @@ class LinearSVCModel(Model, LinearSVCModelParams):
         if device_in:  # device data in -> device predictions out, no D2H
             cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
         else:
+            from ...utils.packing import packed_device_get
+
+            # one packed, accounted readback (two np.asarray pulls would
+            # each pay their own tunnel round trip)
+            pred_h, raw_h = packed_device_get(pred, raw, sync_kind="transform")
             cols = {
-                self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
-                self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
+                self.get_prediction_col(): pred_h.astype(np.float64),
+                self.get_raw_prediction_col(): raw_h.astype(np.float64),
             }
         return [table.with_columns(cols)]
 

@@ -34,6 +34,7 @@ from ...common.param import (
 from ...ops.losses import BINARY_LOGISTIC_LOSS
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 from .. import _linear
 
@@ -59,7 +60,7 @@ class LogisticRegressionParams(
     pass
 
 
-@jax.jit
+@lazy_jit
 def _predict_from_dot(dot):
     """dot >= 0 -> label 1; rawPrediction = [1-p, p], p = sigmoid(dot)
     (LogisticRegressionModel.predictOneDataPoint:165-168)."""
@@ -69,7 +70,7 @@ def _predict_from_dot(dot):
     return pred, raw
 
 
-@jax.jit
+@lazy_jit
 def _predict(X, coeff):
     return _predict_from_dot(X @ coeff)
 
@@ -127,9 +128,14 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
         if device_in:  # device data in -> device predictions out, no D2H
             cols = {self.get_prediction_col(): pred, self.get_raw_prediction_col(): raw}
         else:
+            from ...utils.packing import packed_device_get
+
+            # one packed, accounted readback (two np.asarray pulls would
+            # each pay their own tunnel round trip)
+            pred_h, raw_h = packed_device_get(pred, raw, sync_kind="transform")
             cols = {
-                self.get_prediction_col(): np.asarray(pred, dtype=np.float64),
-                self.get_raw_prediction_col(): np.asarray(raw, dtype=np.float64),
+                self.get_prediction_col(): pred_h.astype(np.float64),
+                self.get_raw_prediction_col(): raw_h.astype(np.float64),
             }
         return [table.with_columns(cols)]
 

@@ -296,6 +296,7 @@ class NaiveBayesModel(Model, NaiveBayesModelParams):
                         jnp.asarray(X[s : s + chunk], jnp.float32),
                         cats, logp, pi, labels,
                     )
+                    # tpulint: disable=host-sync-leak -- error path: fit already failed validation; pulls locate the offending value for the message
                     rows, cols = np.nonzero(~np.asarray(seen))
                     bad = float(np.asarray(X[s + rows[0], cols[0]]))
                     raise ValueError(

@@ -36,6 +36,7 @@ from ...param import DoubleParam, ParamValidators
 from ...parallel.iteration import iterate_unbounded
 from ...table import StreamTable, Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 
@@ -70,7 +71,7 @@ class OnlineLogisticRegressionParams(
         return self.set(self.BETA, value)
 
 
-@jax.jit
+@lazy_jit
 def _ftrl_step(coeff, z, n, X, y, alpha, beta, l1, l2):
     """One global batch: mean per-dim gradient then the FTRL-Proximal update
     (OnlineLogisticRegression.UpdateModel.processElement)."""

@@ -36,6 +36,7 @@ from ...parallel import mesh as mesh_lib
 from ...parallel import prefetch as h2d
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 
@@ -93,12 +94,12 @@ def _lloyd_train_impl(X, weights, init_centroids, max_iter, measure_name):
     return centroids, counts
 
 
-_lloyd_train = jax.jit(_lloyd_train_impl, static_argnames=("measure_name",))
+_lloyd_train = lazy_jit(_lloyd_train_impl, static_argnames=("measure_name",))
 # Donating variant for fit-owned buffers: the staged/padded dataset, the
 # synthesized unit weights, and the initial centroids are all consumed by
 # the train loop, so XLA may reuse their HBM in place instead of holding a
 # second copy for the duration of the fit.
-_lloyd_train_donating = jax.jit(
+_lloyd_train_donating = lazy_jit(
     _lloyd_train_impl, static_argnames=("measure_name",), donate_argnums=(0, 1, 2)
 )
 
@@ -161,7 +162,12 @@ class KMeansModel(Model, KMeansModelParams):
             jnp.asarray(X, jnp.float32), centroids
         )
         if not isinstance(X, jax.Array):  # host in -> host out
-            assign = np.asarray(assign, dtype=np.int32)
+            from ...utils.packing import packed_device_get
+
+            # accounted single readback instead of a silent np.asarray pull
+            assign = packed_device_get(assign, sync_kind="transform")[0].astype(
+                np.int32
+            )
         return [table.with_column(self.get_prediction_col(), assign)]
 
     def _save_extra(self, path: str) -> None:
@@ -179,7 +185,7 @@ class KMeansModel(Model, KMeansModelParams):
             self.centroids, self.weights = loaded
 
 
-@partial(jax.jit, static_argnames=("measure_name",))
+@partial(lazy_jit, static_argnames=("measure_name",))
 def _accumulate_batch(X, w, centroids, measure_name):
     """Per-batch Lloyd accumulation for out-of-core training: assign each
     row to its closest centroid and return (sums, counts) partials that the
@@ -207,7 +213,7 @@ def _sample_without_replacement(rng: np.random.RandomState, n: int, k: int) -> n
     return np.asarray(out, dtype=np.int64)
 
 
-@partial(jax.jit, static_argnames=("n_pad", "sharding"))
+@partial(lazy_jit, static_argnames=("n_pad", "sharding"))
 def _stage_points(X, n_pad, sharding):
     """Device-side row padding + sharding for device-born inputs (the
     benchmark generators produce tables in HBM) — no host round trip."""
@@ -216,7 +222,7 @@ def _stage_points(X, n_pad, sharding):
     return jax.lax.with_sharding_constraint(X, sharding)
 
 
-@partial(jax.jit, static_argnames=("d", "mat_sharding", "row_sharding"))
+@partial(lazy_jit, static_argnames=("d", "mat_sharding", "row_sharding"))
 def _unpack_points(packed, d, mat_sharding, row_sharding):
     """Split the dtype-packed [X | w] stream batch on device, constrained
     to the accumulation shardings — the single-transfer layout the stream
@@ -226,7 +232,7 @@ def _unpack_points(packed, d, mat_sharding, row_sharding):
     return X, w
 
 
-@partial(jax.jit, static_argnames=("n_pad", "sharding"))
+@partial(lazy_jit, static_argnames=("n_pad", "sharding"))
 def _unit_weights(n, n_pad, sharding):
     # n is a traced operand: one compiled program per n_pad, not per (n, n_pad)
     w = (jnp.arange(n_pad) < n).astype(jnp.float32)

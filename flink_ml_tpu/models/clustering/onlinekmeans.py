@@ -35,6 +35,7 @@ from ...parallel import prefetch as h2d
 from ...parallel.iteration import iterate_unbounded
 from ...table import StreamTable, Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 from .kmeans import KMeansModelParams
 
@@ -78,7 +79,7 @@ def _extract_model_data(table: Table):
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("measure_name",))
+@partial(lazy_jit, static_argnames=("measure_name",))
 def _batch_update(centroids, weights, X, decay, measure_name):
     measure = DistanceMeasure.get_instance(measure_name)
     assign = measure.find_closest(X, centroids)
@@ -162,9 +163,12 @@ class OnlineKMeansModel(Model, KMeansModelParams):
         assign = jit_find_closest(self.get_distance_measure())(
             jnp.asarray(X, jnp.float32), jnp.asarray(self.centroids, jnp.float32)
         )
+        from ...utils.packing import packed_device_get
+
+        assign_h = packed_device_get(assign[:n], sync_kind="transform")[0]
         return [
             table.with_column(
-                self.get_prediction_col(), np.asarray(assign[:n], dtype=np.int32)
+                self.get_prediction_col(), assign_h.astype(np.int32)
             )
         ]
 

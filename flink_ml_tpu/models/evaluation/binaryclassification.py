@@ -22,6 +22,7 @@ from ...api import AlgoOperator
 from ...common.param import HasLabelCol, HasRawPredictionCol, HasWeightCol
 from ...param import ParamValidators, StringArrayParam
 from ...table import Table
+from ...utils.lazyjit import lazy_jit
 
 # numpy 2 renamed trapz -> trapezoid; support both
 _trapezoid = getattr(np, "trapezoid", None) or np.trapz
@@ -109,7 +110,7 @@ def _binary_metrics(scores: np.ndarray, labels: np.ndarray, weights: np.ndarray)
     }
 
 
-@jax.jit
+@lazy_jit
 def _binary_metrics_device(scores, labels, weights):
     """The same four metrics as `_binary_metrics` in ONE jitted device pass,
     returned packed as [auc, aupr, lorenz, ks] (single readback).
@@ -220,11 +221,14 @@ class BinaryClassificationEvaluator(AlgoOperator, BinaryClassificationEvaluatorP
             if weight_col is None
             else table.column(weight_col)
         )
-        packed = np.asarray(
+        from ...utils.packing import packed_device_get
+
+        packed = packed_device_get(
             _binary_metrics_device(
                 jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)
-            )
-        )
+            ),
+            sync_kind="transform",
+        )[0]
         metrics = {
             AREA_UNDER_ROC: float(packed[0]),
             AREA_UNDER_PR: float(packed[1]),

@@ -74,5 +74,7 @@ class DCT(Transformer, DCTParams):
         mat = B.T if self.get_inverse() else B
         out = _matmul(jnp.asarray(X, jnp.float32), jnp.asarray(mat.T, jnp.float32))
         if not isinstance(X, jax.Array):
-            out = np.asarray(out)
+            from ...utils.packing import packed_device_get
+
+            out = packed_device_get(out, sync_kind="transform")[0]
         return [table.with_column(self.get_output_col(), out)]

@@ -143,7 +143,11 @@ class IDF(Estimator, IDFParams):
             import jax
 
             if isinstance(X, jax.Array):
-                df = np.asarray(_count_nonzero_per_col(X), dtype=np.float64)
+                from ...utils.packing import packed_device_get
+
+                df = packed_device_get(
+                    _count_nonzero_per_col(X), sync_kind="fit"
+                )[0].astype(np.float64)
             else:
                 df = (X != 0).sum(axis=0).astype(np.float64)
             n_docs = X.shape[0]

@@ -23,6 +23,7 @@ from ...common.param import HasInputCol, HasOutputCol
 from ...param import IntParam, ParamValidators, StringParam
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 UNIFORM = "uniform"
@@ -161,17 +162,17 @@ class KBinsDiscretizerModel(Model, KBinsDiscretizerModelParams):
         self.bin_edges = [np.asarray(e, dtype=np.float64) for e in arrays["binEdges"]]
 
 
-@jax.jit
+@lazy_jit
 def _col_quantiles(a, qs):
     return jnp.quantile(a, qs, axis=0)
 
 
-@jax.jit
+@lazy_jit
 def _col_min_max(a):
     return jnp.stack([jnp.min(a, axis=0), jnp.max(a, axis=0)])
 
 
-@jax.jit
+@lazy_jit
 def _bin_all(X, edges_mat, nbins):
     """Per-column binning as one compare-sum sweep: bucket = #edges <= x
     minus 1 (== searchsorted side='right' - 1, +inf padding never counts).
@@ -207,7 +208,11 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         # per-column edge cleanup (tiny) runs on host
         if strategy == UNIFORM:
             if isinstance(X, jax.Array):
-                lo_hi = np.asarray(_col_min_max(X), dtype=np.float64)
+                from ...utils.packing import packed_device_get
+
+                lo_hi = packed_device_get(_col_min_max(X), sync_kind="fit")[
+                    0
+                ].astype(np.float64)
             else:  # host float64 stays float64 (device cast would round)
                 lo_hi = np.stack([np.min(X, axis=0), np.max(X, axis=0)]).astype(
                     np.float64
@@ -221,10 +226,11 @@ class KBinsDiscretizer(Estimator, KBinsDiscretizerParams):
         elif strategy == QUANTILE:
             qs = np.linspace(0.0, 1.0, num_bins + 1)
             if isinstance(X, jax.Array):
-                all_edges = np.asarray(
-                    _col_quantiles(X, jnp.asarray(qs, X.dtype)),
-                    dtype=np.float64,
-                )  # (num_bins + 1, d)
+                from ...utils.packing import packed_device_get
+
+                all_edges = packed_device_get(
+                    _col_quantiles(X, jnp.asarray(qs, X.dtype)), sync_kind="fit"
+                )[0].astype(np.float64)  # (num_bins + 1, d)
             else:
                 all_edges = np.quantile(np.asarray(X, np.float64), qs, axis=0)
             for j in range(X.shape[1]):

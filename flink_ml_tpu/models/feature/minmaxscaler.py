@@ -131,7 +131,7 @@ class MinMaxScalerModel(Model, MinMaxScalerParams):
         self.min_vector, self.max_vector = arrays["minVector"], arrays["maxVector"]
 
 
-@jax.jit
+@lazy_jit
 def _column_min_max(X):
     return jnp.min(X, axis=0), jnp.max(X, axis=0)
 

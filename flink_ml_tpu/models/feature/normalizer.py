@@ -17,6 +17,7 @@ from ...api import Transformer
 from ...common.param import HasInputCol, HasOutputCol
 from ...param import DoubleParam, ParamValidators
 from ...table import Table, as_dense_matrix
+from ...utils.lazyjit import lazy_jit
 
 
 class NormalizerParams(HasInputCol, HasOutputCol):
@@ -29,7 +30,7 @@ class NormalizerParams(HasInputCol, HasOutputCol):
         return self.set(self.P, value)
 
 
-@jax.jit
+@lazy_jit
 def _normalize(X, p):
     norms = jnp.sum(jnp.abs(X) ** p, axis=1) ** (1.0 / p)
     return X / jnp.maximum(norms, 1e-30)[:, None]
@@ -55,5 +56,7 @@ class Normalizer(Transformer, NormalizerParams):
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
         out = _normalize(jnp.asarray(X), jnp.asarray(self.get_p()))
         if not isinstance(X, jax.Array):
-            out = np.asarray(out)
+            from ...utils.packing import packed_device_get
+
+            out = packed_device_get(out, sync_kind="transform")[0]
         return [table.with_column(self.get_output_col(), out)]

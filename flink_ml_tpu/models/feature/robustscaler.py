@@ -23,6 +23,7 @@ from ...common.param import HasInputCol, HasOutputCol, HasRelativeError
 from ...param import BooleanParam, DoubleParam, ParamValidators
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 
@@ -148,7 +149,7 @@ class RobustScalerModel(Model, RobustScalerModelParams):
         self.medians, self.ranges = arrays["medians"], arrays["ranges"]
 
 
-@jax.jit
+@lazy_jit
 def _quantiles(X, qs):
     return jnp.quantile(X, qs, axis=0)
 
@@ -165,7 +166,11 @@ class RobustScaler(Estimator, RobustScalerParams):
         else:
             X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
             qs = jnp.asarray([0.5, self.get_lower(), self.get_upper()])
-            med, lo, hi = np.asarray(_quantiles(jnp.asarray(X), qs), dtype=np.float64)
+            from ...utils.packing import packed_device_get
+
+            med, lo, hi = packed_device_get(
+                _quantiles(jnp.asarray(X), qs), sync_kind="fit"
+            )[0].astype(np.float64)
         model = RobustScalerModel()
         model.medians = med
         model.ranges = hi - lo

@@ -21,6 +21,7 @@ from ...common.param import HasInputCol, HasOutputCol
 from ...param import BooleanParam
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 
@@ -45,7 +46,7 @@ class StandardScalerParams(HasInputCol, HasOutputCol):
         return self.set(self.WITH_STD, value)
 
 
-@jax.jit
+@lazy_jit
 def _fit_stats(X):
     n = X.shape[0]
     mean = jnp.mean(X, axis=0)

@@ -19,6 +19,7 @@ from ...common.param import HasInputCol, HasOutputCol
 from ...param import DoubleParam, ParamValidators
 from ...table import Table, as_dense_matrix
 from ...utils import read_write
+from ...utils.lazyjit import lazy_jit
 from ...utils.param_utils import update_existing_params
 
 
@@ -91,7 +92,7 @@ class VarianceThresholdSelectorModel(Model, VarianceThresholdSelectorModelParams
         )["indices"]
 
 
-@jax.jit
+@lazy_jit
 def _sample_variance(X):
     n = X.shape[0]
     mean = jnp.mean(X, axis=0)
@@ -104,7 +105,9 @@ class VarianceThresholdSelector(Estimator, VarianceThresholdSelectorParams):
     def fit(self, *inputs: Table) -> VarianceThresholdSelectorModel:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_input_col()), allow_device=True)
-        var = np.asarray(_sample_variance(jnp.asarray(X)))
+        from ...utils.packing import packed_device_get
+
+        var = packed_device_get(_sample_variance(jnp.asarray(X)), sync_kind="fit")[0]
         model = VarianceThresholdSelectorModel()
         model.indices = np.nonzero(var > self.get_variance_threshold())[0]
         update_existing_params(model, self)

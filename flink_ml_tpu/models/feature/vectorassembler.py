@@ -102,10 +102,11 @@ class VectorAssembler(Transformer, VectorAssemblerParams):
             # flag is the only readback unless rows must be skipped
             out, any_bad = _assemble_kernel(*mats)
             result = table.with_column(self.get_output_col(), out)
-            from ...obs import tracing
+            from ...utils.packing import packed_device_get
 
-            tracing.account_host_sync("transform")
-            if bool(any_bad):
+            # the flag pull IS the transform's one sync; packed_device_get
+            # accounts it (host_sync.transform + readback bytes) in one place
+            if bool(packed_device_get(any_bad, sync_kind="transform")[0]):
                 if handle == HasHandleInvalid.ERROR_INVALID:
                     raise ValueError(
                         "Encountered NaN while assembling a row with handleInvalid = 'error'. "
@@ -114,7 +115,9 @@ class VectorAssembler(Transformer, VectorAssemblerParams):
                 if handle == HasHandleInvalid.SKIP_INVALID:
                     import jax.numpy as jnp
 
-                    bad = np.asarray(jnp.isnan(out).any(axis=1))
+                    bad = packed_device_get(
+                        jnp.isnan(out).any(axis=1), sync_kind="transform"
+                    )[0]
                     result = result.take(np.nonzero(~bad)[0])
             return [result]
         mats = [np.asarray(m) for m in mats]

@@ -150,7 +150,9 @@ class VectorIndexer(Estimator, VectorIndexerParams):
             # count distinct per column on device (one sorted pass, one
             # readback); only columns under the category limit — typically
             # few or none for continuous data — pull their values to host
-            counts = np.asarray(_nunique_per_column(X))
+            from ...utils.packing import packed_device_get
+
+            counts = packed_device_get(_nunique_per_column(X), sync_kind="fit")[0]
             for j in range(X.shape[1]):
                 if counts[j] <= max_cat:
                     category_maps[j] = _build_category_map(np.asarray(X[:, j]))

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
 from ..parallel import prefetch as h2d
+from ..utils.lazyjit import lazy_jit
 from .losses import LossFunc
 
 
@@ -88,14 +89,14 @@ _LAYOUT_STATICS = ("n", "num_batches", "batch", "b_pad", "d_pad", "sharding")
 # Borrowed variant for caller-owned buffers (device-born Table columns);
 # donating variant for buffers _batchify staged itself — donation lets XLA
 # free the flat copy during layout, halving peak HBM for the dataset.
-_layout_batches = jax.jit(_layout_batches_impl, static_argnames=_LAYOUT_STATICS)
-_layout_batches_donating = jax.jit(
+_layout_batches = lazy_jit(_layout_batches_impl, static_argnames=_LAYOUT_STATICS)
+_layout_batches_donating = lazy_jit(
     _layout_batches_impl, static_argnames=_LAYOUT_STATICS, donate_argnums=(0,)
 )
 
 
 @partial(
-    jax.jit,
+    lazy_jit,
     static_argnames=("n", "num_batches", "batch", "b_pad", "dtype", "sharding"),
 )
 def _default_weights(n, num_batches, batch, b_pad, dtype, sharding):
@@ -147,7 +148,7 @@ def _update_model(coeff, grad, wsum, lr, reg, elastic_net):
 # per stream fit on the jit.compiles counter. As a jitted function all
 # operands are runtime arguments, so every fit at a given model shape
 # re-enters one executable.
-_final_update = jax.jit(_update_model)
+_final_update = lazy_jit(_update_model)
 
 
 def _binomial_labels_ok(y):
@@ -195,7 +196,7 @@ def _pack_train_result(coeff, criteria, epochs, flag=None, pack_sharding=None):
 
 
 @partial(
-    jax.jit,
+    lazy_jit,
     static_argnames=("loss_func", "batch", "has_weights", "check_labels"),
 )
 def _sgd_train_flat(X, y, w, init_coeff, loss_func, batch, has_weights, n, hyper, check_labels):
@@ -247,7 +248,7 @@ def _sgd_train_flat(X, y, w, init_coeff, loss_func, batch, has_weights, n, hyper
     return _pack_train_result(coeff, criteria, epochs, flag)
 
 
-@partial(jax.jit, static_argnames=("loss_func", "check_labels", "pack_sharding"))
+@partial(lazy_jit, static_argnames=("loss_func", "check_labels", "pack_sharding"))
 def _sgd_train(X_b, y_b, w_b, init_coeff, loss_func, hyper, check_labels, pack_sharding):
     """The full bounded training iteration as one XLA program.
 
@@ -330,13 +331,13 @@ def _stream_epoch_impl(Xk, yk, wk, carry, criteria, loss_func, hyper):
 # Borrowing variant for epochs whose post-state must stay readable on host
 # (checkpoint snapshot pending); donating variant ping-pongs the carry in
 # place in HBM (carry and criteria are argnums 3 and 4).
-_stream_epoch = jax.jit(_stream_epoch_impl, static_argnames=("loss_func",))
-_stream_epoch_donating = jax.jit(
+_stream_epoch = lazy_jit(_stream_epoch_impl, static_argnames=("loss_func",))
+_stream_epoch_donating = lazy_jit(
     _stream_epoch_impl, static_argnames=("loss_func",), donate_argnums=(3, 4)
 )
 
 
-@partial(jax.jit, static_argnames=("d", "mat_sharding", "row_sharding"))
+@partial(lazy_jit, static_argnames=("d", "mat_sharding", "row_sharding"))
 def _unpack_stream_batch(packed, d, mat_sharding, row_sharding):
     """Split the dtype-packed [X | y | w] stream batch back into its parts
     ON DEVICE, constrained to the training shardings. The pack exists so a
@@ -376,8 +377,8 @@ def _sgd_chunk_impl(X_b, y_b, w_b, carry, criteria, loss_func, hyper, chunk_end)
     return carry, criteria, packed
 
 
-_sgd_chunk = jax.jit(_sgd_chunk_impl, static_argnames=("loss_func",))
-_sgd_chunk_donating = jax.jit(
+_sgd_chunk = lazy_jit(_sgd_chunk_impl, static_argnames=("loss_func",))
+_sgd_chunk_donating = lazy_jit(
     _sgd_chunk_impl, static_argnames=("loss_func",), donate_argnums=(3, 4)
 )
 

@@ -14,8 +14,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.lazyjit import lazy_jit
 
-@jax.jit
+
+@lazy_jit
 def _select_matmul(a, s):
     return jnp.matmul(a, s, precision=jax.lax.Precision.HIGHEST)
 

@@ -160,7 +160,9 @@ def anova_f_test(
 
         y_dev = y if _is_jax(y) else jnp.asarray(np.asarray(y))
         n, d = X.shape
-        k = int(np.asarray(_nunique_device(y_dev)))
+        from ..utils.packing import packed_device_get
+
+        k = int(packed_device_get(_nunique_device(y_dev), sync_kind="fit")[0])
         classes = _unique_device(y_dev, k)
         sums, counts, total_sq = _anova_device_sums(X, y_dev, classes, k)
     else:
@@ -218,7 +220,11 @@ def f_value_test(
             else jnp.asarray(np.asarray(y) if not _is_jax(y) else y, X.dtype)
         )
         n, d = X.shape
-        m = np.asarray(_centered_moments(X, y_dev)).astype(np.float64)
+        from ..utils.packing import packed_device_get
+
+        m = packed_device_get(_centered_moments(X, y_dev), sync_kind="fit")[
+            0
+        ].astype(np.float64)
         ss_x, num = m[0][:-1], m[1][:-1]
         ss_y = m[0][-1]
         den = np.sqrt(ss_x * ss_y)

@@ -23,6 +23,7 @@ import numpy as np
 from jax import lax
 
 from ..parallel.prefetch import stage_to_device
+from ..utils.lazyjit import lazy_jit
 
 
 def _count_dtype():
@@ -34,7 +35,7 @@ def _count_dtype():
     return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 
-@partial(jax.jit, static_argnames=("num_terms",))
+@partial(lazy_jit, static_argnames=("num_terms",))
 def term_counts(ids, num_terms):
     """Corpus term frequency + document frequency per vocab id, packed as
     one (2, num_terms) array so the host reads both back in a single
@@ -62,7 +63,7 @@ def term_counts(ids, num_terms):
     return jnp.stack([tf, df]).astype(_count_dtype())
 
 
-@partial(jax.jit, static_argnames=("binary",))
+@partial(lazy_jit, static_argnames=("binary",))
 def row_term_runs(mapped, thr_row, binary=False):
     """Per-row (term, count) runs over a mapped id matrix, as padded-CSR
     (indices, values) with -1 padding — the SparseBatch layout.
@@ -103,7 +104,7 @@ OOMs 16GB HBM around n*k = 1e9 — chunking bounds transients to ~2GB while
 dispatches still pipeline (one readback at the end)."""
 
 
-@partial(jax.jit, static_argnames=("num_terms",))
+@partial(lazy_jit, static_argnames=("num_terms",))
 def _term_counts_dense(ids, num_terms):
     """Small-vocabulary tf/df: one fused broadcast-compare reduction each —
     no row sort (see `row_term_counts_dense` for why)."""
@@ -156,7 +157,7 @@ def _pack_dense_counts(counts, thr_row, k, num_terms, binary):
     return indices, counts_sorted.astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("num_terms", "binary"))
+@partial(lazy_jit, static_argnames=("num_terms", "binary"))
 def row_term_counts_dense(mapped, thr_row, num_terms, binary=False):
     """Small-vocabulary variant of `row_term_runs`: per-row counts via a
     fused broadcast-compare reduction, then ONE packed sort (gather-free;
@@ -168,7 +169,7 @@ def row_term_counts_dense(mapped, thr_row, num_terms, binary=False):
     return _pack_dense_counts(counts, thr_row, k, num_terms, binary)
 
 
-@partial(jax.jit, static_argnames=("num_terms", "binary"))
+@partial(lazy_jit, static_argnames=("num_terms", "binary"))
 def _counts_dense_preimage(ids, pre, thr_row, num_terms, binary=False):
     """`row_term_counts_dense` of lut-mapped ids WITHOUT materializing the
     mapped matrix or gathering: counts[r, v] = #{j : ids[r, j] == pre[v]}
@@ -192,7 +193,7 @@ a win over the (n, k) gather for u up to ~1k (the gather runs at ~1.5 GB/s
 traced; the compare sweep streams at HBM speed)."""
 
 
-@jax.jit
+@lazy_jit
 def compare_map(ids, lut):
     """Gather-free `gather_map` for small dictionaries: mapped[r, j] =
     max_d(where(ids[r, j] == d, lut[d], -1)) — exactly one d matches a
@@ -219,21 +220,21 @@ def lut_preimage(lut_host: np.ndarray, num_terms: int):
     return pre
 
 
-@partial(jax.jit, static_argnames=("binary",))
+@partial(lazy_jit, static_argnames=("binary",))
 def _map_and_runs(ids, lut, thr_row, binary=False):
     """gather_map fused with row_term_runs so the mapped matrix exists only
     as a chunk-local temp, never as a full (n, k) allocation."""
     return row_term_runs(gather_map(ids, lut), thr_row, binary=binary)
 
 
-@partial(jax.jit, static_argnames=("num_terms", "binary"))
+@partial(lazy_jit, static_argnames=("num_terms", "binary"))
 def _map_and_counts_dense(ids, lut, thr_row, num_terms, binary=False):
     return row_term_counts_dense(
         gather_map(ids, lut), thr_row, num_terms, binary=binary
     )
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(lazy_jit, donate_argnums=(0,))
 def _paste(buf, part, start):
     """Donated in-place chunk write: XLA aliases buf instead of copying the
     whole output per chunk (a jnp.concatenate of all chunks would briefly
@@ -306,7 +307,7 @@ def map_term_runs_chunked(
     return indices, values
 
 
-@jax.jit
+@lazy_jit
 def gather_map(ids, lut):
     """Map ids through a lookup table; -1 stays -1 (absent/OOV)."""
     return jnp.where(ids >= 0, lut[jnp.where(ids >= 0, ids, 0)], -1)
@@ -328,7 +329,7 @@ def _compact_kept(ids, keep, V):
     return jnp.take_along_axis(jnp.where(keep, ids, -1), order, axis=1)
 
 
-@jax.jit
+@lazy_jit
 def filter_tokens(ids, keep_vocab):
     """Drop tokens whose vocab id is masked out (StopWordsRemover
     semantics). The keep test is a (n, k) gather over the mask — prefer
@@ -337,7 +338,7 @@ def filter_tokens(ids, keep_vocab):
     return _compact_kept(ids, keep, keep_vocab.shape[0])
 
 
-@partial(jax.jit, static_argnames=("vocab_size",))
+@partial(lazy_jit, static_argnames=("vocab_size",))
 def filter_tokens_dropset(ids, drop_ids, vocab_size):
     """`filter_tokens` via membership test against the (small) dropped-id
     set instead of a (n, k) mask gather: keep = no drop_id matches — a
@@ -378,7 +379,7 @@ def filter_tokens_chunked(ids, keep_vocab, chunk_rows: int = CHUNK_ROWS):
     return out
 
 
-@partial(jax.jit, static_argnames=("num_terms", "gram"))
+@partial(lazy_jit, static_argnames=("num_terms", "gram"))
 def ngram_codes(ids, num_terms, gram):
     """Combine adjacent token ids into base-`num_terms` n-gram codes:
     code = ids[j]*u^(g-1) + ... + ids[j+g-1]. Rows shorter than the window
@@ -396,7 +397,7 @@ def ngram_codes(ids, num_terms, gram):
     return jnp.where(valid, code, -1)
 
 
-@jax.jit
+@lazy_jit
 def _remap_codes(codes, uniq):
     ranks = jnp.searchsorted(uniq, codes)
     return jnp.where(codes >= 0, ranks.astype(jnp.int32), jnp.int32(-1))
@@ -435,8 +436,12 @@ def ngram_vocab_observed(vocab: np.ndarray, gram: int, codes):
     fraction of the combinatorial space; here the distinct codes are found
     on device (one (m,) readback, m = distinct observed grams) and only
     those decode to space-joined strings. -1 (absent) is preserved."""
+    from ..utils.packing import packed_device_get
+
     u = len(vocab)
-    uniq_host = np.asarray(jnp.unique(codes.ravel()))
+    (uniq_host,) = packed_device_get(
+        jnp.unique(codes.ravel()), sync_kind="transform"
+    )
     uniq_host = uniq_host[uniq_host >= 0]
     # reindex codes to compact ranks on device (searchsorted over the
     # sorted distinct codes); -1 sentinel passes through. Chunked: the

@@ -97,7 +97,9 @@ def axis_size(axis_name: str = DATA_AXIS) -> int:
     only inside shard_map/pmap tracing). pre-graft jax lacks lax.axis_size;
     psum of the constant 1 folds to the static size on both versions."""
     if hasattr(lax, "axis_size"):
+        # tpulint: disable=host-sync-leak -- static mapped-axis size, folded at trace time; no device value crosses
         return int(lax.axis_size(axis_name))
+    # tpulint: disable=host-sync-leak -- psum of the constant 1 folds to the static axis size at trace time
     return int(lax.psum(1, axis_name))
 
 
@@ -378,6 +380,7 @@ def _host_reduce_fn(mesh: Mesh, shape: Tuple[int, ...], dtype) -> Callable:
         def _sum(stacked):
             return jnp.sum(stacked, axis=0)
 
+        # tpulint: disable=retrace-hazard -- cached in _HOST_REDUCE_CACHE keyed (mesh, shape, dtype); compile count pinned by test_collective_chunks
         fn = jax.jit(_sum, out_shardings=sharding)
         _HOST_REDUCE_CACHE[key] = fn
     return fn

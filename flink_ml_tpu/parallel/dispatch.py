@@ -111,7 +111,9 @@ def chunk_runner(body) -> ChunkRunner:
         return carry, epoch, criteria, packed
 
     runner = ChunkRunner(
+        # tpulint: disable=retrace-hazard -- wrapper pair cached per body object in _runner_cache (keyed on `body`)
         donating=jax.jit(chunk_step, donate_argnums=(0, 1, 2)),
+        # tpulint: disable=retrace-hazard -- wrapper pair cached per body object in _runner_cache (keyed on `body`)
         borrowing=jax.jit(chunk_step),
     )
     _runner_cache[body] = runner

@@ -182,7 +182,7 @@ def iterate_bounded(
 
 
 def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[float]):
-    from ..utils import metrics
+    from ..utils import metrics, packing
 
     tol_value = -jnp.inf if tol is None else jnp.asarray(float(tol), jnp.float32)
 
@@ -201,11 +201,19 @@ def _iterate_on_device(body: BodyFn, init_carry, max_iter: int, tol: Optional[fl
     # summary (epoch count, final criteria) instead
     with tracing.span("iteration.run", mode="device") as sp:
         with metrics.timed("iteration.device_loop"):
+            # body is a per-call closure: a cached wrapper can never be
+            # reused at this layer (chunked loops ride dispatch.chunk_runner
+            # instead, which caches per body object)
+            # tpulint: disable=retrace-hazard -- per-fit body closure; one dispatch per fit, reuse impossible here
             carry, epochs, criteria = jax.jit(
                 lambda s: lax.while_loop(cond, step, s)
             )(init_state)
-            jax.block_until_ready(criteria)
-        num_epochs, final = int(epochs), float(criteria)
+            # the loop's one convergence drain, through the accounted
+            # funnel (doubles as the barrier that keeps the timing honest)
+            epochs_h, criteria_h = packing.packed_device_get(
+                epochs, criteria, sync_kind="drain"
+            )
+        num_epochs, final = int(epochs_h), float(criteria_h)
         sp.set_attr("epochs", num_epochs)
         sp.set_attr("finalCriteria", final)
     metrics.set_gauge("iteration.epochs", num_epochs)
@@ -343,6 +351,7 @@ def scan_epochs(body: BodyFn, init_carry, num_epochs: int):
         new_carry, criteria = body(carry, epoch)
         return new_carry, criteria
 
+    # tpulint: disable=retrace-hazard -- per-call body closure (bench/loss-curve helper); one dispatch per call
     carry, history = jax.jit(
         lambda c: lax.scan(step, c, jnp.arange(num_epochs, dtype=jnp.int32))
     )(init_carry)

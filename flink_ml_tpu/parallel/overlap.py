@@ -231,6 +231,7 @@ def _build_sgd_program(mesh: Mesh, loss_func, check_labels: bool, sparse_pairs: 
         return _pack_train_result(coeff, criteria, epochs, flag)
 
     mapped = collectives.shard_map_over(mesh, in_specs, P(), fn=train)
+    # tpulint: disable=retrace-hazard -- overlap mode builds one program per fit by design (opt-in; caching keyed on mesh/shape is ROADMAP item 2)
     return jax.jit(mapped)
 
 
@@ -298,4 +299,5 @@ def _build_lloyd_program(mesh: Mesh, measure_name: str):
     mapped = collectives.shard_map_over(
         mesh, (P(axis, None), P(axis), P(), P()), (P(), P()), fn=train
     )
+    # tpulint: disable=retrace-hazard -- overlap mode builds one program per fit by design (opt-in; caching keyed on mesh/shape is ROADMAP item 2)
     return jax.jit(mapped)

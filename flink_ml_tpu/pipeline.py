@@ -149,6 +149,7 @@ class FusedSegment:
         if self._jit is None:
             import jax
 
+            # tpulint: disable=retrace-hazard -- one compile per fused segment; plans are cached keyed on stage ids + params + model-array identities
             self._jit = jax.jit(self._run)
             # stable for this plan's lifetime: a constant/param change
             # invalidates the whole plan (PipelineModel._fusion_plan token)

@@ -2,90 +2,37 @@
 """Fusion-coverage gate: every concrete transform-capable stage must state
 its fusion contract.
 
-The transform-kernel protocol (flink_ml_tpu/api.py) is opt-in, which means
-a newly added stage silently lands on the eager per-stage path — exactly
-the per-stage dispatch overhead the fusion planner exists to remove. This
-check makes that decision explicit and reviewable: every concrete
-`AlgoOperator` subclass (Models included) must either
-
-- override `transform_kernel` (and set `fusable = True`), or
-- set `fusable = False` with a non-empty `fusable_reason` saying WHY the
-  stage cannot run inside a fused device program.
-
-Run directly (exit code 1 on violations) or via
-tests/test_fusion_coverage.py, which keeps the gate in tier-1.
+THIN SHIM over the tpulint rule `fusion-coverage`
+(flink_ml_tpu/analysis/rules/coverage.py) — the class-graph walk and the
+contract logic live there now (docs/static_analysis.md has the
+catalogue; run `scripts/tpulint.py` for the full rule set). This entry
+point keeps the historical CLI contract: same output lines, same exit
+code, and the same `find_violations()` / `_iter_stage_classes()` module
+surface that tests/test_fusion_coverage.py exercises.
 """
 
 from __future__ import annotations
 
-import importlib
-import inspect
 import os
-import pkgutil
 import sys
 from typing import List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from flink_ml_tpu.analysis.rules.coverage import (  # noqa: E402
+    find_fusion_violations,
+)
+
 
 def _iter_stage_classes():
-    import flink_ml_tpu
-    from flink_ml_tpu.api import AlgoOperator
+    from flink_ml_tpu.analysis.rules.coverage import _iter_operator_classes
 
-    roots = [flink_ml_tpu]
-    seen = set()
-    for root in roots:
-        for info in pkgutil.walk_packages(root.__path__, root.__name__ + "."):
-            # extension build tree and CLI entrypoints are not stage modules
-            # (importing a __main__ runs its CLI side effects)
-            if ".native" in info.name or info.name.endswith("__main__"):
-                continue
-            try:
-                module = importlib.import_module(info.name)
-            except Exception as e:  # pragma: no cover - import rot is its own bug
-                raise RuntimeError(f"cannot import {info.name}: {e!r}") from e
-            for _, cls in inspect.getmembers(module, inspect.isclass):
-                if (
-                    issubclass(cls, AlgoOperator)
-                    and not inspect.isabstract(cls)
-                    and cls.__module__ == module.__name__
-                    and cls not in seen
-                ):
-                    seen.add(cls)
-                    yield cls
+    return _iter_operator_classes("AlgoOperator")
 
 
 def find_violations() -> List[Tuple[str, str]]:
     """(qualified class name, problem) for every stage breaking the contract."""
-    from flink_ml_tpu.api import AlgoOperator
-
-    violations = []
-    for cls in _iter_stage_classes():
-        has_kernel = cls.transform_kernel is not AlgoOperator.transform_kernel
-        fusable = cls.__dict__.get("fusable", None)
-        # `fusable` must be declared on the class itself (or an own base that
-        # overrode the AlgoOperator default) — inheriting the bare default
-        # means nobody made the call for this stage
-        declared = any("fusable" in k.__dict__ for k in cls.__mro__[:-1] if k is not AlgoOperator)
-        name = f"{cls.__module__}.{cls.__name__}"
-        if has_kernel:
-            if not getattr(cls, "fusable", False) and cls.__dict__.get("supports_fusion") is None and not declared:
-                violations.append((name, "has transform_kernel but fusable is not declared True"))
-            continue
-        if not declared:
-            violations.append(
-                (name, "no transform_kernel and no explicit fusable declaration")
-            )
-            continue
-        if getattr(cls, "fusable", False):
-            violations.append((name, "fusable = True but transform_kernel is not overridden"))
-            continue
-        reason = getattr(cls, "fusable_reason", "")
-        if not isinstance(reason, str) or not reason.strip():
-            violations.append(
-                (name, "fusable = False without a non-empty fusable_reason")
-            )
-    return violations
+    return find_fusion_violations()
 
 
 def main() -> int:

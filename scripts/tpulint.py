@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""tpulint CLI — run the flink_ml_tpu static-analysis rules.
+
+Usage:
+  scripts/tpulint.py                 # lint flink_ml_tpu/ with every rule
+  scripts/tpulint.py --changed       # only report findings in files that
+                                     # differ from HEAD (fast pre-commit);
+                                     # project-wide rules still see the
+                                     # whole tree
+  scripts/tpulint.py --list-rules    # print the rule catalogue
+  scripts/tpulint.py --rule host-sync-leak [--rule ...]   # subset of rules
+  scripts/tpulint.py path/to/file.py [...]                # subset of files
+  scripts/tpulint.py --show-suppressed   # also print what suppressions hid
+
+Exit status: 0 when there are no unsuppressed findings, 1 otherwise.
+Suppress a deliberate finding with an inline (or preceding-line) comment:
+
+    # tpulint: disable=<rule-id> -- <reason>
+
+Unused suppressions are themselves findings (unused-suppression). The
+rule catalogue with rationale and examples lives in
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flink_ml_tpu.analysis import engine  # noqa: E402
+
+
+def _changed_files(root: str) -> list:
+    """Repo-relative .py files differing from HEAD (staged, unstaged, and
+    untracked)."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    files = []
+    for line in (out + untracked).splitlines():
+        line = line.strip()
+        if line.endswith(".py") and os.path.exists(os.path.join(root, line)):
+            files.append(line)
+    return sorted(set(files))
+
+
+def _list_rules() -> int:
+    for rule in engine.all_rules():
+        print(f"{rule.id}: {rule.title}")
+        print(f"  scope: {', '.join(rule.scope)}")
+        for line in textwrap.wrap(rule.rationale, width=74):
+            print(f"  {line}")
+        if rule.example:
+            for line in rule.example.splitlines():
+                print(f"  e.g. {line}")
+        print()
+    print(
+        f"{engine.UNUSED_SUPPRESSION}: a `# tpulint: disable=` comment that "
+        "matches no finding\n  (built-in; stale annotations rot the audit "
+        "trail and are errors)"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpulint", description="flink_ml_tpu static analysis"
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="repo-relative files to report on (default: whole package)",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="report only files differing from HEAD (fast pre-commit mode)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE_ID",
+        help="run only the given rule id (repeatable)",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print findings hidden by suppressions (the sync census)",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="lint a different tree root (fixture trees in tests; the "
+        "scanned scope is still <root>/flink_ml_tpu)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        return _list_rules()
+
+    root = os.path.abspath(args.root) if args.root else engine.REPO_ROOT
+    rules = None
+    if args.rules:
+        known = {r.id for r in engine.all_rules()}
+        for rule_id in args.rules:
+            if rule_id not in known:
+                parser.error(
+                    f"unknown rule {rule_id!r} (see --list-rules)"
+                )
+        rules = [engine.get_rule(rule_id) for rule_id in args.rules]
+
+    only_paths = None
+    if args.changed:
+        only_paths = _changed_files(root)
+        if not only_paths:
+            print("tpulint: no files differ from HEAD")
+            return 0
+    if args.paths:
+        normalized = [
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            for p in args.paths
+        ]
+        only_paths = (
+            normalized
+            if only_paths is None
+            else sorted(set(only_paths) & set(normalized))
+        )
+
+    report = engine.run(root=root, rules=rules, only_paths=only_paths)
+
+    if args.show_suppressed and report.suppressed:
+        print(f"-- {len(report.suppressed)} suppressed finding(s):")
+        for finding in report.suppressed:
+            print(f"   {finding.format()}")
+    for finding in report.findings:
+        print(finding.format())
+    if report.findings:
+        print(
+            f"tpulint: {len(report.findings)} finding(s) "
+            f"({len(report.suppressed)} suppressed)"
+        )
+        return 1
+    print(
+        f"tpulint: clean ({len(report.suppressed)} suppressed finding(s) "
+        "— run --show-suppressed for the census)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

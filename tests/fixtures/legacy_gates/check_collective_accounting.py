@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Collective-accounting gate: no raw lax collectives in models/ or ops/.
+
+Every collective a model or op dispatches must ride the accounted wrappers
+in flink_ml_tpu/parallel/collectives.py — that is what keeps the
+`collective.*` counters (and the BENCH `collectiveBreakdown` field) an
+exhaustive answer to "what traffic does this program move". A raw
+`lax.psum` in a model would execute fine and silently disappear from the
+accounting, so this gate fails the build instead: it scans every .py file
+under flink_ml_tpu/models and flink_ml_tpu/ops for direct calls to the
+collective lax primitives (comments and string literals are stripped via
+tokenize, so docstrings that *mention* psum stay legal).
+
+GSPMD-inserted collectives (sharded contractions letting XLA place the
+all-reduce) are invisible to source scanning and intentionally out of
+scope — the gate covers the explicit-SPMD surface, where bypassing the
+wrappers is a one-line mistake.
+
+Run directly (exit code 1 on violations) or via
+tests/test_collective_accounting.py, which keeps the gate in tier-1.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCANNED_DIRS = ("flink_ml_tpu/models", "flink_ml_tpu/ops")
+
+# the collective primitives the accounted wrappers cover
+_PRIMITIVES = (
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+)
+_PATTERN = re.compile(
+    r"\blax\s*\.\s*(" + "|".join(_PRIMITIVES) + r")\s*\("
+)
+
+
+def _code_only(source: str) -> str:
+    """Source with comments and string/docstring tokens blanked (newlines
+    kept, so reported line numbers stay true)."""
+    out = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenError:
+        return source
+    lines = source.splitlines(keepends=True)
+    drop = []  # (srow, scol, erow, ecol) spans to blank
+    for tok in tokens:
+        if tok.type in (tokenize.COMMENT, tokenize.STRING):
+            drop.append((tok.start, tok.end))
+    for line_no, line in enumerate(lines, start=1):
+        buf = list(line)
+        for (srow, scol), (erow, ecol) in drop:
+            if srow <= line_no <= erow:
+                lo = scol if line_no == srow else 0
+                hi = ecol if line_no == erow else len(buf)
+                for i in range(lo, min(hi, len(buf))):
+                    if buf[i] not in "\r\n":
+                        buf[i] = " "
+        out.append("".join(buf))
+    return "".join(out)
+
+
+def find_violations() -> List[Tuple[str, int, str]]:
+    """(path, line, primitive) for every raw collective call in scope."""
+    violations = []
+    for rel_dir in SCANNED_DIRS:
+        base = os.path.join(ROOT, rel_dir)
+        for dirpath, _, filenames in os.walk(base):
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path) as f:
+                    code = _code_only(f.read())
+                for i, line in enumerate(code.splitlines(), start=1):
+                    for match in _PATTERN.finditer(line):
+                        violations.append(
+                            (os.path.relpath(path, ROOT), i, match.group(1))
+                        )
+    return violations
+
+
+def main() -> int:
+    violations = find_violations()
+    if violations:
+        print(
+            f"collective accounting: {len(violations)} raw lax collective "
+            "call(s) bypass the accounted wrappers "
+            "(use flink_ml_tpu.parallel.collectives instead):"
+        )
+        for path, line, prim in violations:
+            print(f"  {path}:{line}: lax.{prim}(...)")
+        return 1
+    print(
+        "collective accounting: no raw lax collectives in "
+        + " or ".join(SCANNED_DIRS)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,3 +97,51 @@ def test_packed_device_get_round_trips():
     # host inputs pass through untouched
     (h,) = packing.packed_device_get(np.asarray([1.0]))
     np.testing.assert_array_equal(h, [1.0])
+
+# ---------------------------------------------------------------------------
+# host-input transforms: the pulls the tpulint host-sync-leak rule fixed
+# ---------------------------------------------------------------------------
+# Before the tpulint pass these paths pulled device results back with bare
+# np.asarray — a silent, UNACCOUNTED device→host sync (hostSyncCount 0 on
+# the estimator's BENCH entry despite a real tunnel round trip, and two
+# round trips for the two-column predictors). Now they ride
+# packed_device_get: exactly ONE accounted sync per transform.
+
+
+def _transform_sync_delta(fn):
+    from flink_ml_tpu.utils import metrics
+
+    before = metrics.snapshot()["counters"].get("iteration.host_sync.transform", 0)
+    fn()
+    after = metrics.snapshot()["counters"].get("iteration.host_sync.transform", 0)
+    return after - before
+
+
+def test_kmeans_host_transform_sync_is_accounted(readback_counter):
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+    X = np.random.RandomState(0).rand(64, 4)
+    table = Table({"features": X})
+    model = KMeans().set_k(3).set_max_iter(3).fit(table)
+    readback_counter.clear()
+    delta = _transform_sync_delta(lambda: model.transform(table))
+    assert delta == 1  # was 0 accounted (silent np.asarray) before the fix
+    assert len(readback_counter) == 1  # ... and exactly one real transfer
+
+
+def test_logreg_host_transform_is_one_packed_sync(readback_counter):
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+
+    rng = np.random.RandomState(1)
+    X = rng.rand(128, 6)
+    y = (rng.rand(128) > 0.5).astype(np.float64)
+    table = Table({"features": X, "label": y})
+    model = LogisticRegression().set_max_iter(3).fit(table)
+    readback_counter.clear()
+    delta = _transform_sync_delta(lambda: model.transform(table))
+    # prediction + rawPrediction come back in ONE packed transfer (two
+    # bare np.asarray pulls would each pay their own tunnel round trip)
+    assert delta == 1
+    assert len(readback_counter) == 1

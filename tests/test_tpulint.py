@@ -1,0 +1,227 @@
+"""Tier-1 tpulint gate: the full rule pass over flink_ml_tpu/ must report
+zero unsuppressed findings — this is the static rail the dispatch-bound
+perf work runs on (docs/static_analysis.md). Also pins the CLI contract:
+exit 0 on the clean tree, exit 1 with file:line + rule id when any single
+known-bad fixture is seeded, and a working --changed fast path."""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPULINT = os.path.join(REPO, "scripts", "tpulint.py")
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, TPULINT, *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_package_is_clean_full_rule_pass():
+    """THE gate: every rule over the whole package, zero unsuppressed
+    findings (suppressions carry reasons and are the audited sync census;
+    an unused suppression would itself fail this)."""
+    from flink_ml_tpu.analysis import engine
+
+    report = engine.run()
+    assert report.findings == [], "\n".join(f.format() for f in report.findings)
+    # the census is non-empty: deliberate sync/compile points are annotated
+    assert len(report.suppressed) >= 5
+
+
+def test_cli_exit_zero_on_clean_tree():
+    result = _run_cli()
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "clean" in result.stdout
+
+
+def test_cli_list_rules_catalogue():
+    result = _run_cli("--list-rules")
+    assert result.returncode == 0
+    for rule_id in (
+        "host-sync-leak",
+        "retrace-hazard",
+        "donation-after-use",
+        "sharding-tags",
+        "collective-accounting",
+        "upload-accounting",
+        "fusion-coverage",
+        "checkpoint-coverage",
+        "unused-suppression",
+    ):
+        assert rule_id in result.stdout, rule_id
+
+
+def _seed_tree(tmp_path, rel, source, extra=None):
+    """A minimal fixture package containing one known-bad file."""
+    files = {
+        "__init__.py": "",
+        "utils/__init__.py": "",
+        "utils/lazyjit.py": "def lazy_jit(fn, **kw):\n    return fn\n",
+        "models/__init__.py": "",
+        rel: textwrap.dedent(source),
+    }
+    files.update(extra or {})
+    for name, src in files.items():
+        path = tmp_path / "flink_ml_tpu" / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return tmp_path
+
+
+SEED_CASES = [
+    (
+        "raw-jax-jit",
+        "models/bad.py",
+        """
+        import jax
+
+        def _impl(x):
+            return x
+
+        _kernel = jax.jit(_impl)
+        """,
+        "retrace-hazard",
+        "flink_ml_tpu/models/bad.py:7",
+        None,
+    ),
+    (
+        "unaccounted-item",
+        "models/bad.py",
+        """
+        import jax.numpy as jnp
+
+        def fit(X):
+            return jnp.mean(X).item()
+        """,
+        "host-sync-leak",
+        "flink_ml_tpu/models/bad.py:5",
+        None,
+    ),
+    (
+        "donated-then-read",
+        "models/bad.py",
+        """
+        import jax
+
+        def _impl(a, b):
+            return a + b
+
+        _step_donating = jax.jit(_impl, donate_argnums=(0,))
+
+        def fit(carry, other):
+            out = _step_donating(carry, other)
+            return out + carry
+        """,
+        "donation-after-use",
+        "flink_ml_tpu/models/bad.py:11",
+        None,
+    ),
+    (
+        "unknown-ckpt-tag",
+        "models/bad.py",
+        """
+        from ..ckpt.snapshot import save_job_snapshot
+
+        def checkpoint(path, carry):
+            save_job_snapshot(path, "job", {"model": carry},
+                              specs={"model": "fully_sharded"})
+        """,
+        "sharding-tags",
+        "flink_ml_tpu/models/bad.py:6",
+        {
+            "ckpt/__init__.py": "",
+            "ckpt/snapshot.py": (
+                '_SPEC_TAGS = ("replicated", "data", "model", "host")\n'
+                "def _sharding_for(tag, mesh, ndim):\n"
+                '    if tag == "data":\n'
+                "        return 1\n"
+                '    if tag == "model":\n'
+                "        return 2\n"
+                "    return 0\n"
+                "def save_job_snapshot(path, key, sections, specs=None, **kw):\n"
+                "    pass\n"
+                "def stage_section(snap, name, mesh=None, specs=None):\n"
+                "    pass\n"
+            ),
+            "parallel/__init__.py": "",
+            "parallel/mesh.py": (
+                "def replicated_sharding(mesh):\n    pass\n"
+                "def data_sharding(mesh, ndim=1):\n    pass\n"
+                "def model_sharding(mesh, ndim=1):\n    pass\n"
+            ),
+        },
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,rel,source,rule,location,extra",
+    SEED_CASES,
+    ids=[c[0] for c in SEED_CASES],
+)
+def test_seeded_known_bad_fixture_fails_with_location(
+    tmp_path, name, rel, source, rule, location, extra
+):
+    """Acceptance contract: seeding any single known-bad fixture makes the
+    CLI exit 1 and name the file:line and rule id."""
+    root = _seed_tree(tmp_path, rel, source, extra)
+    result = _run_cli("--root", str(root), "--rule", rule)
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert location in result.stdout, result.stdout
+    assert rule in result.stdout
+
+
+def test_changed_mode_reports_only_changed_files(tmp_path):
+    """--changed lints files differing from HEAD (here: a fresh git repo
+    whose HEAD lacks the planted bad file)."""
+    root = _seed_tree(
+        tmp_path,
+        "models/bad.py",
+        """
+        import jax
+
+        def _impl(x):
+            return x
+
+        _kernel = jax.jit(_impl)
+        """,
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GIT_AUTHOR_NAME": "t",
+        "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t",
+        "GIT_COMMITTER_EMAIL": "t@t",
+    }
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=str(root), check=True, capture_output=True, env=env
+        )
+
+    git("init", "-q")
+    # only the clean files are committed: bad.py stays untracked, i.e.
+    # "changed relative to HEAD"
+    git("add", "flink_ml_tpu/__init__.py", "flink_ml_tpu/utils")
+    git("commit", "-q", "-m", "seed")
+    result = _run_cli("--root", str(root), "--changed", "--rule", "retrace-hazard")
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "flink_ml_tpu/models/bad.py:7" in result.stdout
+
+    # everything committed -> nothing differs from HEAD -> exit 0 fast
+    git("add", "-A")
+    git("commit", "-q", "-m", "rest")
+    result = _run_cli("--root", str(root), "--changed", "--rule", "retrace-hazard")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "no files differ" in result.stdout

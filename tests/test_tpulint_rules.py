@@ -1,0 +1,556 @@
+"""Per-rule unit tests for the tpulint framework: every rule proves a
+true positive (known-bad source is flagged), a true negative (the
+idiomatic good pattern is not), and — for the file-scanned rules — that a
+``# tpulint: disable=<rule> -- reason`` suppression hides the finding
+while an unmatched suppression is itself reported."""
+
+import os
+import textwrap
+
+import pytest
+
+from flink_ml_tpu.analysis import engine
+from flink_ml_tpu.analysis.engine import Project
+from flink_ml_tpu.analysis.source import SourceModule, code_only
+
+
+def _make_tree(tmp_path, files):
+    """Write a fixture package tree under tmp_path/flink_ml_tpu and load a
+    Project over it. `files` maps package-relative paths to source."""
+    for rel, src in files.items():
+        path = tmp_path / "flink_ml_tpu" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return Project.load(root=str(tmp_path), scope=("flink_ml_tpu",))
+
+
+def _run(tmp_path, files, rule_ids):
+    project = _make_tree(tmp_path, files)
+    rules = [engine.get_rule(r) for r in rule_ids]
+    return engine.run(root=str(tmp_path), rules=rules, project=project)
+
+
+LAZYJIT_STUB = {
+    "utils/lazyjit.py": """
+        def lazy_jit(fn, **kw):
+            return fn
+        def keyed_jit(make, **kw):
+            return make
+    """,
+    "utils/__init__.py": "",
+    "__init__.py": "",
+}
+
+
+# ---------------------------------------------------------------------------
+# host-sync-leak
+# ---------------------------------------------------------------------------
+
+class TestHostSyncLeak:
+    def test_true_positive_np_asarray_on_device_value(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def fit(X):
+                    dev = jnp.sum(X, axis=0)
+                    return np.asarray(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.rule == "host-sync-leak"
+        assert f.path == "flink_ml_tpu/models/bad.py"
+        assert f.line == 7
+
+    def test_true_positive_item_and_casts(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+
+                def fit(X):
+                    loss = jnp.mean(X)
+                    a = loss.item()
+                    b = float(loss)
+                    return a, b
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        kinds = sorted(f.data[0] for f in report.findings)
+        assert kinds == ["cast", "item"]
+
+    def test_true_positive_block_until_ready(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+
+                def wait(x):
+                    jax.block_until_ready(x)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert [f.data[0] for f in report.findings] == ["block_until_ready"]
+
+    def test_true_negative_host_values_and_funnel(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def fit(X, hyper):
+                    host = np.asarray(hyper)          # host in, host out
+                    n = int(X.shape[0])               # shape metadata
+                    dev = jnp.sum(X, axis=0)
+                    from ..utils.packing import packed_device_get
+                    out = packed_device_get(dev, sync_kind="fit")[0]
+                    return np.asarray(out), host, n   # funnel output is host
+            """,
+            "utils/packing.py": "def packed_device_get(*a, **k):\n    return list(a)\n",
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert report.findings == []
+
+    def test_suppression_hides_and_unused_is_reported(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def fit(X):
+                    dev = jnp.sum(X)
+                    # tpulint: disable=host-sync-leak -- deliberate: tiny scalar, cold path
+                    a = np.asarray(dev)
+                    # tpulint: disable=host-sync-leak -- stale annotation
+                    b = np.asarray(X.shape)
+                    return a, b
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert len(report.suppressed) == 1
+        assert [f.rule for f in report.findings] == ["unused-suppression"]
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+class TestRetraceHazard:
+    def test_true_positive_raw_jit_and_closure(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+
+                def fit(X, lr):
+                    def step(c):
+                        return c * lr
+                    return jax.jit(step)(X)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["retrace-hazard"])
+        tags = sorted(f.data[0] for f in report.findings)
+        assert tags == ["closure", "raw-jit"]
+
+    def test_true_positive_static_key_fstring(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from ..utils.lazyjit import lazy_jit
+
+                def make(fn, name):
+                    return lazy_jit(fn, static_argnames=f"{name}_arg")
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["retrace-hazard"])
+        assert [f.data[0] for f in report.findings] == ["static-key"]
+
+    def test_true_negative_lazyjit_module_level(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": """
+                from ..utils.lazyjit import keyed_jit, lazy_jit
+
+                def _impl(x):
+                    return x + 1
+
+                _kernel = lazy_jit(_impl, static_argnames=("n",))
+                _family = keyed_jit(lambda k: _impl)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["retrace-hazard"])
+        assert report.findings == []
+
+    def test_suppression(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+
+                def _impl(x):
+                    return x
+
+                # tpulint: disable=retrace-hazard -- cached by the caller keyed on mesh
+                _kernel = jax.jit(_impl)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["retrace-hazard"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].data[0] == "raw-jit"
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+DONATING_PRELUDE = (
+    "import jax\n"
+    "\n"
+    "def _impl(a, b):\n"
+    "    return a + b\n"
+    "\n"
+    "_step = jax.jit(_impl)\n"
+    "_step_donating = jax.jit(_impl, donate_argnums=(0,))\n"
+)
+
+
+class TestDonationAfterUse:
+    def test_true_positive_read_after_donate(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": DONATING_PRELUDE + (
+                "def fit(carry, other):\n"
+                "    out = _step_donating(carry, other)\n"
+                "    return out + carry  # carry's buffer was donated\n"
+            ),
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("carry", "_step_donating")
+
+    def test_true_positive_through_gating_alias(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": DONATING_PRELUDE + (
+                "def fit(carry, other, ok):\n"
+                "    step = _step_donating if ok else _step\n"
+                "    out = step(carry, other)\n"
+                "    return out + carry\n"
+            ),
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert len(report.findings) == 1
+
+    def test_true_negative_pingpong_rebind(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": DONATING_PRELUDE + (
+                "def fit(carry, other):\n"
+                "    carry = _step_donating(carry, other)  # rebound: fine\n"
+                "    keep = _step(carry, other)            # borrowing: fine\n"
+                "    return carry + keep + other\n"
+            ),
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert report.findings == []
+
+    def test_suppression(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": DONATING_PRELUDE + (
+                "def fit(carry, other):\n"
+                "    out = _step_donating(carry, other)\n"
+                "    # tpulint: disable=donation-after-use -- CPU-only debug helper\n"
+                "    return out + carry\n"
+            ),
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# sharding-tags
+# ---------------------------------------------------------------------------
+
+SNAPSHOT_FIXTURE = {
+    "ckpt/snapshot.py": """
+        _SPEC_TAGS = ("replicated", "data", "model", "host")
+
+        def _sharding_for(tag, mesh, ndim):
+            if tag == "data":
+                return "D"
+            if tag == "model":
+                return "M"
+            return "R"
+
+        def save_job_snapshot(path, key, sections, specs=None, **kw):
+            pass
+
+        def stage_section(snap, name, mesh=None, specs=None):
+            pass
+    """,
+    "ckpt/__init__.py": "",
+    "parallel/mesh.py": """
+        def replicated_sharding(mesh):
+            pass
+
+        def data_sharding(mesh, ndim=1):
+            pass
+
+        def model_sharding(mesh, ndim=1):
+            pass
+    """,
+    "parallel/__init__.py": "",
+}
+
+
+class TestShardingTags:
+    def test_true_positive_unknown_tag_at_call_site(self, tmp_path):
+        report = _run(tmp_path, {
+            **SNAPSHOT_FIXTURE,
+            "models/bad.py": """
+                from ..ckpt.snapshot import save_job_snapshot
+
+                def checkpoint(path, carry):
+                    save_job_snapshot(
+                        path, "job", {"model": carry},
+                        specs={"model": "fully_sharded"},
+                    )
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["sharding-tags"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("fully_sharded",)
+        assert report.findings[0].path == "flink_ml_tpu/models/bad.py"
+
+    def test_true_positive_table_without_constructor(self, tmp_path):
+        fixture = dict(SNAPSHOT_FIXTURE)
+        fixture["ckpt/snapshot.py"] = fixture["ckpt/snapshot.py"].replace(
+            '"replicated", "data", "model", "host"',
+            '"replicated", "data", "model", "host", "striped"',
+        )
+        report = _run(tmp_path, {**fixture, **LAZYJIT_STUB}, ["sharding-tags"])
+        tags = {f.data[0] for f in report.findings if f.data}
+        assert "striped" in tags
+
+    def test_true_negative_known_tags_and_local_indirection(self, tmp_path):
+        report = _run(tmp_path, {
+            **SNAPSHOT_FIXTURE,
+            "models/good.py": """
+                from ..ckpt.snapshot import save_job_snapshot, stage_section
+
+                def checkpoint(path, carry, shard):
+                    carry_specs = (
+                        ("model", "replicated") if shard else "replicated"
+                    )
+                    save_job_snapshot(
+                        path, "job", {"model": carry},
+                        specs={"model": carry_specs, "rng": "host"},
+                    )
+                    stage_section(None, "model", specs=carry_specs)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["sharding-tags"])
+        assert report.findings == []
+
+    def test_suppression(self, tmp_path):
+        report = _run(tmp_path, {
+            **SNAPSHOT_FIXTURE,
+            "models/bad.py": """
+                from ..ckpt.snapshot import save_job_snapshot
+
+                def checkpoint(path, carry):
+                    save_job_snapshot(
+                        path, "job", {"model": carry},
+                        # tpulint: disable=sharding-tags -- forward-compat tag, staged by a plugin
+                        specs={"model": "fully_sharded"},
+                    )
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["sharding-tags"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# ported accounting gates
+# ---------------------------------------------------------------------------
+
+class TestAccountingRules:
+    def test_collective_true_positive_and_docstring_negative(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                '''lax.psum(x, axis) in a docstring is fine.'''
+                from jax import lax
+
+                # lax.psum(x) in a comment is fine
+                def f(x):
+                    return lax.psum(x, "data")
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["collective-accounting"])
+        assert [(f.line, f.data[0]) for f in report.findings] == [(7, "psum")]
+
+    def test_collective_out_of_scope_dir_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "parallel/infra.py": """
+                from jax import lax
+
+                def f(x):
+                    return lax.psum(x, "data")
+            """,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["collective-accounting"])
+        assert report.findings == []
+
+    def test_upload_true_positive_and_suppression(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+
+                def stage(x):
+                    a = jax.device_put(x)
+                    # tpulint: disable=upload-accounting -- test-only helper
+                    b = jax.device_put(x)
+                    return a, b
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["upload-accounting"])
+        assert [f.line for f in report.findings] == [5]
+        assert [f.line for f in report.suppressed] == [7]
+
+
+# ---------------------------------------------------------------------------
+# ported coverage gates (import-based; synthetic class graph)
+# ---------------------------------------------------------------------------
+
+class TestCoverageRules:
+    def test_fusion_true_positive_synthetic(self, monkeypatch):
+        from flink_ml_tpu.analysis.rules import coverage
+
+        class Silent:  # neither kernel nor declaration
+            pass
+
+        monkeypatch.setattr(
+            coverage, "_iter_operator_classes", lambda base: iter(())
+        )
+        monkeypatch.setattr(
+            coverage.FusionCoverageRule,
+            "finder",
+            staticmethod(
+                lambda: [("fake.Silent", "no transform_kernel and no explicit "
+                          "fusable declaration")]
+            ),
+        )
+        rule = coverage.FusionCoverageRule()
+        findings = list(rule.check_project(Project(root=os.getcwd())))
+        assert len(findings) == 1
+        assert findings[0].rule == "fusion-coverage"
+        assert "Silent" in findings[0].message
+
+    def test_fusion_and_checkpoint_true_negative_on_real_tree(self):
+        from flink_ml_tpu.analysis.rules.coverage import (
+            find_checkpoint_violations,
+            find_fusion_violations,
+        )
+
+        assert find_fusion_violations() == []
+        assert find_checkpoint_violations() == []
+
+    def test_checkpoint_violation_logic_synthetic(self):
+        from flink_ml_tpu.analysis.rules import coverage
+
+        # the funnel check reads comment/string-stripped source
+        assert not any(
+            funnel in code_only('"""mentions run_sgd only in docs."""\n')
+            for funnel in coverage.CHECKPOINT_FUNNELS
+        )
+        assert any(
+            funnel in code_only("coeff = run_sgd(params)\n")
+            for funnel in coverage.CHECKPOINT_FUNNELS
+        )
+
+
+# ---------------------------------------------------------------------------
+# engine / suppression machinery
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_unknown_rule_suppression_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/odd.py": """
+                # tpulint: disable=no-such-rule -- whatever
+                x = 1
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert [f.rule for f in report.findings] == ["unused-suppression"]
+        assert "unknown rule" in report.findings[0].message
+
+    def test_inline_and_preceding_line_suppressions(self):
+        mod = SourceModule(
+            path="m.py",
+            abspath="m.py",
+            source="",
+        )
+        src = (
+            "import numpy as np\n"
+            "# tpulint: disable=rule-a -- above\n"
+            "x = 1\n"
+            "y = 2  # tpulint: disable=rule-b -- inline\n"
+        )
+        from flink_ml_tpu.analysis.source import _parse_suppressions
+
+        sups = _parse_suppressions(src)
+        assert [(s.rule, s.line, s.reason) for s in sups] == [
+            ("rule-a", 3, "above"),
+            ("rule-b", 4, "inline"),
+        ]
+        del mod
+
+    def test_code_only_blanks_strings_and_comments(self):
+        stripped = code_only('x = "lax.psum"  # lax.psum\ny = 2\n')
+        assert "psum" not in stripped
+        assert "y = 2" in stripped
+        # line structure is preserved for true line numbers
+        assert stripped.count("\n") == 2
+
+    def test_rule_catalogue_metadata_complete(self):
+        for rule in engine.all_rules():
+            assert rule.id and rule.title and rule.rationale, rule
+            assert rule.scope, rule.id
+
+    def test_findings_filtered_by_only_paths(self, tmp_path):
+        project = _make_tree(tmp_path, {
+            "models/a.py": "import jax\nf = jax.jit(int)\n",
+            "models/b.py": "import jax\ng = jax.jit(int)\n",
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        })
+        rules = [engine.get_rule("retrace-hazard")]
+        full = engine.run(root=str(tmp_path), rules=rules, project=project)
+        assert len(full.findings) == 2
+        # reload (Suppression.used state is per-Project)
+        project = Project.load(root=str(tmp_path), scope=("flink_ml_tpu",))
+        partial = engine.run(
+            root=str(tmp_path),
+            rules=rules,
+            project=project,
+            only_paths=["flink_ml_tpu/models/a.py"],
+        )
+        assert [f.path for f in partial.findings] == ["flink_ml_tpu/models/a.py"]
