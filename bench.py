@@ -731,6 +731,175 @@ def bench_checkpoint_resume(n=200_000, d=64, max_iter=24, kill_after_chunks=8):
     return result
 
 
+def bench_overload_soak(num_requests=60, batch_rows=256, d=24):
+    """Robustness workload (ISSUE 8): bursty producer x slow/flaky
+    consumer, asserted in-process:
+
+    1. **Overloaded serving sheds at the door with bounded memory** — an
+       unpaced producer fires `num_requests` submits at a MicroBatchServer
+       with a small admission queue + in-flight window. The reject policy
+       must fast-fail (ServerOverloaded) instead of queueing, both queue
+       depths must peak within their configured capacities (the bounded-
+       peak-memory claim, reported in bytes), every admitted request must
+       retire, and the dispatch worker must exit — zero deadlock,
+       enforced by a bounded join.
+    2. **shed_oldest bounds model staleness** — a producer bursts 40x the
+       channel capacity between consumer gets; consumed lag must stay
+       BELOW the capacity while sheds are counted (the staleness contract
+       of docs/flow_control.md).
+    3. **Transient-fault retries are result-invisible** — one stream-SGD
+       fit runs clean, then again with a flaky spill-read fault under the
+       retry budget (bit-identical coefficients required, retries proven
+       by the fault plan AND the flow.retry counter), then again with the
+       budget at 0 (the same fault must now be fatal).
+    """
+    import jax
+
+    from flink_ml_tpu import config, flow
+    from flink_ml_tpu.ckpt import faults
+    from flink_ml_tpu.ckpt.faults import TransientFault
+    from flink_ml_tpu.models.feature.normalizer import Normalizer
+    from flink_ml_tpu.models.feature.standardscaler import StandardScalerModel
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.pipeline import PipelineModel
+    from flink_ml_tpu.serving import MicroBatchServer, ServerOverloaded
+    from flink_ml_tpu.table import Table
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(17)
+    t_start = time.perf_counter()
+
+    # -- 1. serving under burst: reject at the door, bounded queues --------
+    scaler = StandardScalerModel()
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.1
+    scaler.set_input_col("features").set_output_col("scaled")
+    pipeline = PipelineModel(
+        [scaler, Normalizer().set_p(2.0).set_input_col("scaled").set_output_col("norm")]
+    )
+    server = MicroBatchServer(pipeline, in_flight=2, admission=4)
+    batch_nbytes = batch_rows * d * 4
+    submitted = rejected = 0
+    for _ in range(num_requests):
+        try:
+            server.submit(Table({"features": rng.standard_normal((batch_rows, d), dtype=np.float32)}))
+            submitted += 1
+        except ServerOverloaded as e:
+            assert e.depth <= e.capacity, "reject must fire AT capacity, not past it"
+            rejected += 1
+    server.close()
+    results = list(server.results())
+    server._worker.join(timeout=120.0)
+    assert not server._worker.is_alive(), "dispatch worker wedged: deadlock"
+    health = server.health()
+    assert submitted + rejected == num_requests
+    assert len(results) == submitted, "every admitted request must retire"
+    assert all(r.status == "ok" for r in results)
+    peak_admit = server._requests.stats.peak_depth
+    peak_window = server._window.stats.peak_depth
+    assert peak_admit <= server.admission, "admission queue exceeded its bound"
+    assert peak_window <= server.in_flight, "in-flight window exceeded its bound"
+    jax.block_until_ready(
+        [results[-1].table.column("norm")] if results else []
+    )
+    # deadline leg on a fresh server: a request whose deadline passed
+    # before dispatch is shed WITHOUT paying staging or compute
+    expiry_server = MicroBatchServer(pipeline, in_flight=2, admission=8)
+    expired_submits = 0
+    for _ in range(5):
+        try:
+            expiry_server.submit(
+                Table({"features": rng.standard_normal((batch_rows, d), dtype=np.float32)}),
+                deadline_ms=0.0,
+            )
+            expired_submits += 1
+        except ServerOverloaded:
+            pass
+    expiry_server.close()
+    expiry_results = list(expiry_server.results())
+    assert len(expiry_results) == expired_submits
+    expired = sum(1 for r in expiry_results if r.status in ("expired", "late"))
+    assert expired == expired_submits, "0ms-deadline requests must be shed/late"
+
+    # -- 2. shed_oldest staleness bound ------------------------------------
+    capacity = 4
+    chan = flow.BoundedChannel(capacity, policy=flow.SHED_OLDEST, name="soak.online")
+    produced = 0
+    for round_ in range(10):
+        for _ in range(capacity * 40):  # the burst: 40x capacity per get
+            chan.put(produced)
+            produced += 1
+        chan.get()  # the slow consumer folds one item per burst
+    assert chan.stats.shed > 0, "the burst must actually shed"
+    assert chan.stats.max_lag < capacity, (
+        f"staleness contract broken: lag {chan.stats.max_lag} >= capacity {capacity}"
+    )
+
+    # -- 3. retries on vs off: bit-identical or fatal ----------------------
+    X = rng.standard_normal((480, 16)).astype(np.float32)
+    y = (X @ rng.standard_normal(16).astype(np.float32) > 0).astype(np.float32)
+
+    def chunks():
+        return iter([(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)])
+
+    def fit():
+        sgd = SGD(max_iter=6, global_batch_size=100, tol=0.0)
+        return sgd.optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS)
+
+    clean, _, _, _ = fit()
+    retry_before = metrics.get_counter("flow.retry", 0)
+    with config.transient_retry_mode(4):
+        with faults.flaky("datacache.read", times=3) as plan:
+            retried, _, _, _ = fit()
+    retries_paid = metrics.get_counter("flow.retry", 0) - retry_before
+    assert plan.failures == 3, "the flaky plan must actually fire"
+    assert retries_paid >= 3, "retries must ride flow.with_retries (counted)"
+    assert np.array_equal(np.asarray(clean), np.asarray(retried)), (
+        "transient-fault retries changed the training result"
+    )
+    fatal = False
+    with config.transient_retry_mode(0):
+        with faults.flaky("datacache.read", times=1):
+            try:
+                fit()
+            except TransientFault:
+                fatal = True
+    assert fatal, "with the retry budget at 0 the transient fault must be fatal"
+
+    result = {
+        "numRequests": num_requests,
+        "batchRows": batch_rows,
+        "submitted": submitted,
+        "rejected": rejected,
+        "completed": len(results),
+        "admissionCapacity": server.admission,
+        "inFlight": server.in_flight,
+        "peakAdmissionDepth": int(peak_admit),
+        "peakWindowDepth": int(peak_window),
+        # the bounded-memory claim in bytes: the deepest the queues got,
+        # priced at one staged batch each — versus the unbounded
+        # alternative of `rejected` extra batches parked in memory
+        "peakQueuedBytes": int((peak_admit + peak_window) * batch_nbytes),
+        "shedCount": int(chan.stats.shed),
+        "maxStalenessLag": int(chan.stats.max_lag),
+        "stalenessCapacity": capacity,
+        "retryCount": int(retries_paid),
+        "retriesBitIdentical": True,  # asserted above
+        "zeroDeadlock": True,  # asserted above (bounded join)
+        "wallMs": (time.perf_counter() - t_start) * 1000.0,
+    }
+    log(
+        f"overloadSoak: {rejected}/{num_requests} rejected at the door, queue "
+        f"peaks {result['peakAdmissionDepth']}/{result['admissionCapacity']} admit "
+        f"+ {result['peakWindowDepth']}/{result['inFlight']} window "
+        f"({result['peakQueuedBytes'] / 1e6:.1f}MB), staleness lag "
+        f"{result['maxStalenessLag']} < {capacity}, {retries_paid} transient "
+        "retries bit-identical"
+    )
+    return result
+
+
 def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
     """The comm-layer workload (ISSUE 4): per-device-count collective
     traffic and wall time from scripts/bench_collectives.py — bucketed
@@ -803,6 +972,7 @@ def main(argv):
         "pipelineServing": None,
         "inputPipeline": None,
         "checkpointResume": None,
+        "overloadSoak": None,
         "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
@@ -890,6 +1060,12 @@ def main(argv):
                 details["checkpointResume"] = bench_checkpoint_resume()
             except Exception as e:
                 log(f"checkpointResume stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["overloadSoak"] = bench_overload_soak()
+            except Exception as e:
+                log(f"overloadSoak stage failed: {e!r}")
 
         if in_budget():
             try:

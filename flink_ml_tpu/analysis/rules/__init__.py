@@ -5,6 +5,7 @@ from . import (  # noqa: F401
     accounting,
     coverage,
     donation,
+    flowcontrol,
     hostsync,
     retrace,
     shardingtags,

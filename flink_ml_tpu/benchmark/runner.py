@@ -167,6 +167,16 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # files means a loop's snapshot cadence (or payload) changed
         "checkpointCount": int(delta["counters"].get("checkpoint.count", 0)),
         "checkpointBytes": int(delta["counters"].get("checkpoint.bytes", 0)),
+        # flow-control evidence (flow.py): transient-fault retries this
+        # entry paid, items shed/rejected by overloaded channels, and the
+        # deepest any bounded queue got — a retryCount jump between BENCH
+        # files means a dependency got flaky, a shed/reject jump means a
+        # consumer stopped keeping up, and peakQueueDepth is the memory
+        # high-water evidence behind the bounded-overload claim
+        "retryCount": int(delta["counters"].get("flow.retry", 0)),
+        "shedCount": int(delta["counters"].get("flow.shed", 0)),
+        "rejectCount": int(delta["counters"].get("flow.reject", 0)),
+        "peakQueueDepth": int(delta["gauges"].get("flow.peakQueueDepth", 0)),
         # per-op collective traffic this entry traced (calls/bytes/chunks
         # from the accounted wrappers in parallel/collectives.py, plus the
         # sparse-vs-dense byte ratio when a sparse reduce ran) — the

@@ -17,21 +17,38 @@ out of the loop at a controlled point. Two entry styles:
   arms ONE plan with `inject(...)` and the matching tick raises. Sites
   wired in:
 
-  | site             | boundary                                          |
-  |------------------|---------------------------------------------------|
-  | `chunk`          | bounded chunk drained (SGD checkpointed loop,     |
-  |                  | `iterate_bounded` host-driven loop)               |
-  | `epoch`          | stream-training epoch drained (SGD `optimize_     |
-  |                  | stream`, KMeans out-of-core epoch)                |
-  | `batch`          | unbounded global batch folded (`iterate_          |
-  |                  | unbounded` — the online estimators)               |
-  | `snapshot.write` | INSIDE `save_job_snapshot`, after the temp file   |
-  |                  | is written but before the atomic `os.replace` —   |
-  |                  | the torn-write case the atomicity contract covers |
+  | site              | boundary                                          |
+  |-------------------|---------------------------------------------------|
+  | `chunk`           | bounded chunk drained (SGD checkpointed loop,     |
+  |                   | `iterate_bounded` host-driven loop)               |
+  | `epoch`           | stream-training epoch drained (SGD `optimize_     |
+  |                   | stream`, KMeans out-of-core epoch)                |
+  | `batch`           | unbounded global batch folded (`iterate_          |
+  |                   | unbounded` — the online estimators)               |
+  | `snapshot.write`  | INSIDE `save_job_snapshot`, after the temp file   |
+  |                   | is written but before the atomic `os.replace` —   |
+  |                   | the torn-write case the atomicity contract covers |
+  | `snapshot.read`   | INSIDE `load_job_snapshot`, before the npz is     |
+  |                   | opened — the transient-restore-I/O case           |
+  | `datacache.read`  | INSIDE `DataCache.read_array` — a spill-file read |
+  | `datacache.append`| INSIDE `DataCache.append_array` — a spill write   |
+  | `serving.batch`   | INSIDE `MicroBatchServer`'s batch dispatch        |
 
   Ticks fire AFTER the boundary's snapshot save, so an injected kill
   models a crash between a completed checkpoint and the next boundary —
-  except `snapshot.write`, which models the crash mid-checkpoint.
+  except `snapshot.write`, which models the crash mid-checkpoint, and
+  the I/O sites above, which model the I/O call itself failing.
+
+- `flaky(site, times)` — the TRANSIENT twin of `inject`: the site fails
+  its first `times` hits with a `TransientFault` (a
+  `flow.TransientError`, so `flow.with_retries` retries it) and then
+  succeeds. `inject` models a crash — `InjectedFault` is deliberately
+  NOT retryable and kills the job; `flaky` models the blip the retry
+  budget exists for, which makes every retry path fault-injection-
+  testable: arm `flaky("snapshot.read", times=2)` and a restore must
+  survive exactly two failed reads. A flaky plan and an inject plan can
+  be armed simultaneously (different slots); on the same site the fatal
+  plan ticks first.
 
 Disarmed cost is one module-global load per tick — safe on hot loops.
 """
@@ -42,15 +59,40 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Iterator, Optional
 
-__all__ = ["InjectedFault", "FaultPlan", "inject", "tick", "armed", "failing_map"]
+from ..flow import TransientError
+
+__all__ = [
+    "InjectedFault",
+    "TransientFault",
+    "FaultPlan",
+    "FlakyPlan",
+    "inject",
+    "flaky",
+    "tick",
+    "armed",
+    "failing_map",
+]
 
 
 class InjectedFault(RuntimeError):
     """The planted failure. Deliberately NOT a subclass of any framework
-    error: tests assert the kill propagated un-swallowed."""
+    error (and NOT a `flow.TransientError`): it models a crash, so tests
+    assert the kill propagated un-swallowed — a retry wrapper that ate it
+    would un-test the checkpoint path."""
 
     def __init__(self, site: str, hits: int):
         super().__init__(f"injected fault at site {site!r} (hit {hits})")
+        self.site = site
+        self.hits = hits
+
+
+class TransientFault(TransientError):
+    """The planted BLIP: raised by a `flaky` plan for the first N hits of
+    its site, then the site succeeds. Subclasses `flow.TransientError`,
+    so `flow.with_retries` treats it as retryable by contract."""
+
+    def __init__(self, site: str, hits: int):
+        super().__init__(f"transient fault at site {site!r} (hit {hits})")
         self.site = site
         self.hits = hits
 
@@ -65,11 +107,23 @@ class FaultPlan:
     fired: bool = False
 
 
+@dataclass
+class FlakyPlan:
+    """One armed transient: the first `times` hits of `site` raise
+    `TransientFault`, every later hit passes."""
+
+    site: str
+    times: int
+    hits: int = 0
+    failures: int = 0
+
+
 _plan: Optional[FaultPlan] = None
+_flaky: Optional[FlakyPlan] = None
 
 
 def armed() -> bool:
-    return _plan is not None
+    return _plan is not None or _flaky is not None
 
 
 @contextmanager
@@ -87,17 +141,41 @@ def inject(site: str, after: int = 1):
         _plan = prev
 
 
+@contextmanager
+def flaky(site: str, times: int = 1):
+    """Arm a flaky plan for the enclosed block: `site` fails its first
+    `times` hits with `TransientFault`, then succeeds (one flaky plan at
+    a time; nesting shadows). Yields the plan so tests can assert
+    `failures`/`hits` — e.g. that a retry loop paid exactly `times`
+    retries before the site went healthy."""
+    global _flaky
+    prev = _flaky
+    plan = FlakyPlan(site, max(1, int(times)))
+    _flaky = plan
+    try:
+        yield plan
+    finally:
+        _flaky = prev
+
+
 def tick(site: str, count: int = 1) -> None:
-    """Record `count` hits of an injection site; raises `InjectedFault`
-    when the armed plan's threshold is crossed (once — a fired plan stays
-    quiet so cleanup code re-entering the site cannot double-throw)."""
+    """Record `count` hits of an injection site. Raises `InjectedFault`
+    when an armed fatal plan's threshold is crossed (once — a fired plan
+    stays quiet so cleanup code re-entering the site cannot
+    double-throw), and `TransientFault` while an armed flaky plan still
+    has failures to spend."""
     plan = _plan
-    if plan is None or plan.fired or plan.site != site:
-        return
-    plan.hits += count
-    if plan.hits >= plan.after:
-        plan.fired = True
-        raise InjectedFault(site, plan.hits)
+    if plan is not None and not plan.fired and plan.site == site:
+        plan.hits += count
+        if plan.hits >= plan.after:
+            plan.fired = True
+            raise InjectedFault(site, plan.hits)
+    fplan = _flaky
+    if fplan is not None and fplan.site == site:
+        fplan.hits += count
+        if fplan.failures < fplan.times:
+            fplan.failures += 1
+            raise TransientFault(site, fplan.hits)
 
 
 def _default_records(item: Any) -> int:

@@ -54,6 +54,7 @@ from typing import Any, Dict, Optional, Sequence, Union
 
 import numpy as np
 
+from .. import flow
 from ..utils import metrics
 from . import faults
 
@@ -194,11 +195,19 @@ def save_job_snapshot(
         os.makedirs(path, exist_ok=True)
         target = snapshot_file(path, job_key)
         tmp = target[: -len(".npz")] + ".tmp.npz"  # keep .npz so savez won't rename
-        np.savez(tmp, manifest=np.asarray(json.dumps(manifest)), **arrays)
-        # torn-write injection point: a kill here models a crash after the
-        # temp payload hit disk but before the atomic commit below
-        faults.tick("snapshot.write")
-        os.replace(tmp, target)
+
+        def commit() -> None:
+            np.savez(tmp, manifest=np.asarray(json.dumps(manifest)), **arrays)
+            # torn-write injection point: a kill here models a crash after
+            # the temp payload hit disk but before the atomic commit below
+            faults.tick("snapshot.write")
+            os.replace(tmp, target)
+
+        # transient write faults (flaky filesystem, faults.flaky plans)
+        # re-run the WHOLE temp-write-then-rename sequence — safe because
+        # nothing before the os.replace is observable to a reader; a fatal
+        # InjectedFault is not transient and still kills the job mid-write
+        flow.with_retries(commit, site="snapshot.write")
 
         nbytes = sum(a.nbytes for a in arrays.values())
         metrics.inc_counter("checkpoint.count")
@@ -245,52 +254,66 @@ def load_job_snapshot(
     if not os.path.exists(file):
         return _load_legacy(path, job_key, templates)
     with tracing.span("checkpoint.restore", jobKey=job_key or "") as sp:
-        with np.load(file) as f:
-            manifest = json.loads(str(f["manifest"]))
-            version = int(manifest.get("version", -1))
-            if version > SNAPSHOT_VERSION or version < 1:
-                warnings.warn(
-                    f"ignoring job snapshot {file}: format version {version} "
-                    f"(this build reads <= {SNAPSHOT_VERSION})"
-                )
-                return None
-            if expect_meta:
-                stored = manifest.get("meta", {})
-                for k, v in expect_meta.items():
-                    if k in stored and stored[k] != v:
-                        warnings.warn(
-                            f"ignoring job snapshot {file}: meta {k!r} is "
-                            f"{stored[k]!r}, resuming job expects {v!r} (the "
-                            "snapshot belongs to a different data layout)"
-                        )
-                        return None
-            sections: Dict[str, Any] = {}
-            specs: Dict[str, Sequence[str]] = {}
-            for name, section in manifest["sections"].items():
-                entries = section["leaves"]
-                specs[name] = tuple(e.get("spec", "replicated") for e in entries)
-                template = (templates or {}).get(name)
-                if template is None:
-                    sections[name] = [np.asarray(f[e["key"]]) for e in entries]
-                    continue
-                leaves, treedef = _tree_flatten(template)
-                why = _leaf_mismatch(leaves, entries)
-                if why is not None:
+
+        def read():
+            """The retried unit: open + parse the npz. Returns None when
+            the snapshot is refused (foreign/future/cursor-mismatched) —
+            a refusal is a decision, not an I/O failure, so it is never
+            retried; a transient read fault (faults.flaky plans, flaky
+            filesystems) re-runs this whole closure."""
+            faults.tick("snapshot.read")
+            with np.load(file) as f:
+                manifest = json.loads(str(f["manifest"]))
+                version = int(manifest.get("version", -1))
+                if version > SNAPSHOT_VERSION or version < 1:
                     warnings.warn(
-                        f"ignoring job snapshot {file}: section {name!r} is "
-                        f"structurally incompatible ({why}) — it belongs to a "
-                        "different job"
+                        f"ignoring job snapshot {file}: format version {version} "
+                        f"(this build reads <= {SNAPSHOT_VERSION})"
                     )
                     return None
-                # restore on host: np keeps float64 leaves exact; staging
-                # onto the mesh is the caller's move (stage_section)
-                restored = [
-                    np.asarray(f[e["key"]], dtype=leaf.dtype)
-                    if hasattr(leaf, "dtype")
-                    else np.asarray(f[e["key"]])
-                    for leaf, e in zip(leaves, entries)
-                ]
-                sections[name] = jax.tree_util.tree_unflatten(treedef, restored)
+                if expect_meta:
+                    stored = manifest.get("meta", {})
+                    for k, v in expect_meta.items():
+                        if k in stored and stored[k] != v:
+                            warnings.warn(
+                                f"ignoring job snapshot {file}: meta {k!r} is "
+                                f"{stored[k]!r}, resuming job expects {v!r} (the "
+                                "snapshot belongs to a different data layout)"
+                            )
+                            return None
+                sections: Dict[str, Any] = {}
+                specs: Dict[str, Sequence[str]] = {}
+                for name, section in manifest["sections"].items():
+                    entries = section["leaves"]
+                    specs[name] = tuple(e.get("spec", "replicated") for e in entries)
+                    template = (templates or {}).get(name)
+                    if template is None:
+                        sections[name] = [np.asarray(f[e["key"]]) for e in entries]
+                        continue
+                    leaves, treedef = _tree_flatten(template)
+                    why = _leaf_mismatch(leaves, entries)
+                    if why is not None:
+                        warnings.warn(
+                            f"ignoring job snapshot {file}: section {name!r} is "
+                            f"structurally incompatible ({why}) — it belongs to a "
+                            "different job"
+                        )
+                        return None
+                    # restore on host: np keeps float64 leaves exact; staging
+                    # onto the mesh is the caller's move (stage_section)
+                    restored = [
+                        np.asarray(f[e["key"]], dtype=leaf.dtype)
+                        if hasattr(leaf, "dtype")
+                        else np.asarray(f[e["key"]])
+                        for leaf, e in zip(leaves, entries)
+                    ]
+                    sections[name] = jax.tree_util.tree_unflatten(treedef, restored)
+            return manifest, sections, specs
+
+        parsed = flow.with_retries(read, site="snapshot.read")
+        if parsed is None:
+            return None
+        manifest, sections, specs = parsed
         if job_key is None:
             warnings.warn(_UNKEYED_WARNING)
         metrics.inc_counter("checkpoint.restore.count")
@@ -302,7 +325,7 @@ def load_job_snapshot(
             sections=sections,
             specs=specs,
             meta=manifest.get("meta", {}),
-            version=version,
+            version=int(manifest.get("version", -1)),
             path=file,
         )
 

@@ -183,6 +183,78 @@ if os.environ.get("FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"):
     input_prefetch_depth = int(os.environ["FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"])
 
 
+# --- flow control + transient-fault resilience (flow.py) ---------------------
+# Retry budget for transiently-failing I/O sites (snapshot write/read,
+# DataCache spill reads, serving batch execution): extra attempts after the
+# first failure, 0 = fail fast (the pre-flow behavior). Only
+# `flow.TRANSIENT_ERRORS` are retried — data errors and injected kills
+# propagate immediately, and an exhausted budget re-raises the ORIGINAL
+# error with `retry_attempts` attached (docs/flow_control.md).
+transient_retries: int = 2
+# Exponential-backoff schedule for those retries: attempt k sleeps
+# min(retry_max_delay_s, retry_base_delay_s * 2**(k-1)) with full jitter.
+retry_base_delay_s: float = 0.005
+retry_max_delay_s: float = 0.25
+# A stage execution exceeding this multiple of its trailing-mean latency
+# is flagged by flow.StragglerWatchdog (`flow.straggler.*` counters).
+straggler_factor: float = 4.0
+# Overload policy of the online-estimator ingest channel
+# (OnlineKMeans/OnlineLogisticRegression global-batch staging): "block" is
+# lossless credit-based backpressure — every batch is folded, results are
+# deterministic (the test/reference mode). "shed_oldest" bounds BOTH queue
+# memory and model staleness under a producer that outruns the training
+# step (consumed lag < channel capacity, tracked via flow.lag.* /
+# flow.shed); "sample" bounds memory only (the queue degrades to a prefix
+# sample of the stream). Shedding trades exactly-once folding for
+# liveness, so it is opt-in.
+online_overload_policy: str = "block"
+# Admission-queue capacity of MicroBatchServer's push API: submit() raises
+# a typed ServerOverloaded (carrying live queue depth) once this many
+# requests are waiting — bounded memory and bounded client latency instead
+# of a queue that grows until the host dies.
+serving_admission: int = 16
+# Default per-request deadline for submitted serving batches (None = no
+# deadline): a request whose deadline passes before dispatch is shed
+# (`serving.deadlineMiss`), one that finishes late is delivered marked late.
+serving_deadline_ms: Optional[float] = None
+
+
+@contextmanager
+def transient_retry_mode(retries: int):
+    """Scoped override of `transient_retries` (0 disables retries)."""
+    global transient_retries
+    prev = transient_retries
+    transient_retries = max(0, int(retries))
+    try:
+        yield
+    finally:
+        transient_retries = prev
+
+
+@contextmanager
+def online_overload_mode(policy: str):
+    """Scoped override of `online_overload_policy`."""
+    global online_overload_policy
+    if policy not in ("block", "shed_oldest", "sample", "reject"):
+        raise ValueError(f"Unknown overload policy {policy!r}")
+    prev = online_overload_policy
+    online_overload_policy = policy
+    try:
+        yield
+    finally:
+        online_overload_policy = prev
+
+
+if os.environ.get("FLINK_ML_TPU_TRANSIENT_RETRIES"):
+    transient_retries = max(0, int(os.environ["FLINK_ML_TPU_TRANSIENT_RETRIES"]))
+if os.environ.get("FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY") in (
+    "block",
+    "shed_oldest",
+    "sample",
+):
+    online_overload_policy = os.environ["FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY"]
+
+
 # --- persistent XLA compilation cache ----------------------------------------
 # Cold-start killer: compiled executables survive process restarts, so the
 # first fit of a new process reuses the previous process's XLA programs

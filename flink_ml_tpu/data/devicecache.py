@@ -20,15 +20,20 @@ training on accelerators; this module is that lever:
   `devicecache.miss` / `devicecache.evictBytes`, and the
   `devicecache.bytes` gauge for current residency.
 
-- `CachedEpochLoader` — the cache composed with the shared prefetcher
-  (`parallel/prefetch.Prefetcher` semantics): misses are staged by one
-  worker thread up to `config.input_prefetch_depth` batches ahead of the
-  consuming loop, so batch b+1's host-cache read + pack + upload overlap
-  batch b's compute; hits are served synchronously (they cost one dict
-  lookup). Results arrive strictly in key order. A consecutive repeat of
-  the same key (the nb==1 single-batch stream) is served from the last
-  yielded value even at budget 0, preserving the upload-once behavior
-  the hand-rolled loops had.
+- `CachedEpochLoader` — the cache composed with the shared flow-control
+  layer (`flow.BoundedChannel` + `flow.pump`, the same window class the
+  Prefetcher and the serving runner ride): hit resolution and miss
+  staging both run on ONE pump worker up to `config.
+  input_prefetch_depth` batches ahead of the consuming loop, so batch
+  b+1's host-cache read + pack + upload overlap batch b's compute, and
+  every cache/stager access stays serial by construction (exactly one
+  thread ever touches them during an epoch). Results arrive strictly in
+  key order; a worker error (including an injected fault inside the
+  stage callable) re-raises at the consumer after the batches staged
+  before it. A consecutive repeat of the same key (the nb==1
+  single-batch stream) is served from the last resolved value even at
+  budget 0, preserving the upload-once behavior the hand-rolled loops
+  had.
 
 Parity contract (same construction as the dispatch pipeline's chunking
 guarantee): caching changes WHEN bytes move, never what is computed — a
@@ -39,10 +44,10 @@ by tests/test_input_pipeline.py across budgets {0, tiny, unbounded}.
 
 from __future__ import annotations
 
-from collections import OrderedDict, deque
-from concurrent.futures import ThreadPoolExecutor
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, Optional
 
+from .. import flow
 from ..utils import metrics
 
 __all__ = ["DeviceEpochCache", "CachedEpochLoader"]
@@ -126,16 +131,19 @@ class DeviceEpochCache:
 
 class CachedEpochLoader:
     """Serve keyed batches from the device cache, staging misses through
-    a bounded-depth single-worker prefetch.
+    a bounded-depth single-worker pump (`flow.BoundedChannel`).
 
     `stage(key)` (caller-supplied) does the miss work: read the batch
-    from the host cache, pack it, and upload it via the accounted stager
-    — it runs on the worker thread, so it must touch only thread-safe
-    state (the native cache's serial access is preserved because there is
-    exactly one worker). `epoch(keys)` yields the device pytrees in key
-    order; hit-or-miss is decided at schedule time with a strong
-    reference held until consumption, so an eviction between scheduling
-    and consumption cannot drop a batch.
+    from the host cache, pack it, and upload it via the accounted stager.
+    Hit lookup, miss staging and the LRU `put` all run on the ONE pump
+    worker, so the native cache's serial-access constraint — and the
+    device cache's internal state — are single-threaded by construction.
+    `epoch(keys)` yields the device pytrees in key order; every resolved
+    batch travels through the channel as a strong reference, so an
+    eviction between staging and consumption cannot drop it. A
+    consecutive repeat of the same key reuses the last resolved tree
+    with no cache lookup and no re-upload (the nb == 1 single-batch
+    stream), cache enabled or not.
     """
 
     def __init__(
@@ -151,54 +159,31 @@ class CachedEpochLoader:
         self.depth = max(
             1, int(depth if depth is not None else config.input_prefetch_depth)
         )
-        self._last: Optional[tuple] = None  # (key, tree) most recently yielded
+        self._last: Optional[tuple] = None  # (key, tree) most recently resolved
+        self.watchdog = flow.StragglerWatchdog("devicecache.stage")
+
+    def _resolve(self, key: Hashable):
+        """Worker-side hit/miss resolution for one key (serial: one pump
+        worker is the only thread that ever calls this per epoch)."""
+        if self._last is not None and self._last[0] == key:
+            return self._last[1]  # consecutive repeat: no lookup, no upload
+        tree = self.cache.get(key) if self.cache.enabled else None
+        if tree is None:
+            with self.watchdog.observe():
+                tree = self.stage(key)
+            self.cache.put(key, tree)
+        self._last = (key, tree)
+        return tree
 
     def epoch(self, keys: Iterable[Hashable]) -> Iterator:
-        """Yield the device batch for each key in order, running the miss
-        stager up to `depth` keys ahead. Closing the generator early (a
-        tol stop) cancels the speculative staging."""
+        """Yield the device batch for each key in order, resolving up to
+        `depth` keys ahead on the pump worker. Closing the generator
+        early (a tol stop) cancels the speculative staging; a stage error
+        re-raises here, after the batches resolved before it."""
         metrics.set_gauge("prefetch.depth", self.depth)
-        it = iter(keys)
-        # (key, tree_or_None, future_or_None, reuse_prev) — reuse_prev
-        # chains a consecutive repeat of the key just scheduled before it
-        # (the nb == 1 single-batch stream): by FIFO order its predecessor
-        # resolves first, so consumption serves it from `_last` with no
-        # re-upload, cache enabled or not.
-        pending: deque = deque()
-        last_scheduled: Any = _UNSET
-        executor = ThreadPoolExecutor(max_workers=1)
+        channel = flow.BoundedChannel(self.depth, policy=flow.BLOCK, name="devicecache.stage")
+        flow.pump(keys, channel, transform=self._resolve)
         try:
-            exhausted = False
-            while True:
-                while not exhausted and len(pending) < self.depth:
-                    key = next(it, _UNSET)
-                    if key is _UNSET:
-                        exhausted = True
-                        break
-                    if key == last_scheduled or (
-                        not pending
-                        and self._last is not None
-                        and self._last[0] == key
-                    ):
-                        pending.append((key, None, None, True))
-                    else:
-                        hit = self.cache.get(key) if self.cache.enabled else None
-                        if hit is not None:
-                            pending.append((key, hit, None, False))
-                        else:
-                            pending.append(
-                                (key, None, executor.submit(self.stage, key), False)
-                            )
-                    last_scheduled = key
-                if not pending:
-                    return
-                key, tree, fut, reuse_prev = pending.popleft()
-                if reuse_prev:
-                    tree = self._last[1]
-                elif fut is not None:
-                    tree = fut.result()
-                    self.cache.put(key, tree)
-                self._last = (key, tree)
-                yield tree
+            yield from channel
         finally:
-            executor.shutdown(wait=False, cancel_futures=True)
+            channel.cancel()

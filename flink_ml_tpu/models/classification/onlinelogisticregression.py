@@ -233,14 +233,23 @@ class OnlineLogisticRegression(Estimator, OnlineLogisticRegressionParams):
                 alpha, beta, l1, l2,
             )
 
+        from ... import config
         from ...parallel import prefetch as h2d
         from ...parallel.iteration import checkpoint_job_key
 
         init = (coeff, np.zeros(d), np.zeros(d))
         # shared input stager: the (X, y) upload of global batch b+1 runs
         # on the worker thread (accounted, h2d.*) while batch b's FTRL
-        # step executes — micro-batch H2D off the critical path
-        staged = h2d.Prefetcher(h2d.stage_to_device).iterate(rebatch(stream))
+        # step executes — micro-batch H2D off the critical path. The
+        # window is a flow.BoundedChannel under config.
+        # online_overload_policy: "block" (default) is lossless
+        # backpressure; "shed_oldest" bounds memory AND model staleness
+        # when the stream outruns FTRL (flow.shed / flow.lag.online.ingest).
+        staged = h2d.Prefetcher(
+            h2d.stage_to_device,
+            policy=config.online_overload_policy,
+            name="online.ingest",
+        ).iterate(rebatch(stream))
         raw_updates = iterate_unbounded(
             staged, step, init, job_key=checkpoint_job_key(self)
         )

@@ -235,12 +235,22 @@ class OnlineKMeans(Estimator, OnlineKMeansParams):
                 jnp.asarray(X), jnp.asarray(decay), measure_name,
             )
 
+        from ... import config
         from ...parallel.iteration import checkpoint_job_key
 
         # shared input stager: one worker thread uploads global batch b+1
         # (accounted, h2d.*) while batch b's update step runs — the
-        # micro-batch H2D leaves the critical path between steps
-        staged = h2d.Prefetcher(h2d.stage_to_device).iterate(rebatch(stream))
+        # micro-batch H2D leaves the critical path between steps. The
+        # window is a flow.BoundedChannel under config.
+        # online_overload_policy: "block" (default) is lossless
+        # backpressure; "shed_oldest" keeps memory AND model staleness
+        # bounded when the stream outruns the update step (sheds/lag
+        # tracked as flow.shed / flow.lag.online.ingest).
+        staged = h2d.Prefetcher(
+            h2d.stage_to_device,
+            policy=config.online_overload_policy,
+            name="online.ingest",
+        ).iterate(rebatch(stream))
         updates = iterate_unbounded(
             staged,
             step,

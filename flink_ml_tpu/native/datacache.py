@@ -19,6 +19,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from .. import flow
+from ..ckpt import faults
 from ..obs import tracing
 from ..table import SparseBatch, Table
 from ..utils import metrics
@@ -60,16 +62,29 @@ class DataCache:
         metrics.inc_counter("datacache.append")
         metrics.inc_counter("datacache.appendBytes", len(data))
         if self._handle is not None:
+
+            def append_native() -> int:
+                # transient spill-write faults re-run the whole append: a
+                # failed dc_append (rc < 0) commits no segment, so the
+                # retry cannot double-append (faults.flaky plans tick
+                # BEFORE the write for the same reason)
+                faults.tick("datacache.append")
+                seg = self._lib.dc_append(
+                    self._handle, data, ctypes.c_uint64(len(data))
+                )
+                if seg < 0:
+                    raise IOError("native data cache append failed")
+                return int(seg)
+
             spilled_before = self.spilled_segments
-            seg = self._lib.dc_append(self._handle, data, ctypes.c_uint64(len(data)))
-            if seg < 0:
-                raise IOError("native data cache append failed")
+            seg = flow.with_retries(append_native, site="datacache.append")
             spilled = self.spilled_segments > spilled_before
             self._spilled.append(spilled)
             if spilled:  # over budget: this segment was evicted to disk
                 metrics.inc_counter("datacache.evict")
                 tracing.event("cache.evict", category="cache", bytes=len(data), seg=int(seg))
             return int(seg)
+        faults.tick("datacache.append")
         self._segments.append(data)
         self._spilled.append(False)
         return len(self._segments) - 1
@@ -78,24 +93,32 @@ class DataCache:
         dtype, shape = self._meta[seg]
         hit = not (seg < len(self._spilled) and self._spilled[seg])
         metrics.inc_counter("datacache.hit" if hit else "datacache.miss")
-        if self._handle is not None:
-            size = self._lib.dc_segment_size(self._handle, ctypes.c_long(seg))
-            out = np.empty(size, dtype=np.uint8)
-            rc = self._lib.dc_read(
-                self._handle, ctypes.c_long(seg), out.ctypes.data_as(ctypes.c_void_p)
+
+        def read() -> np.ndarray:
+            # the retried unit: a segment read is idempotent, so a
+            # transient spill-file fault (faults.flaky, a network
+            # filesystem blip) just re-reads
+            faults.tick("datacache.read")
+            if self._handle is not None:
+                size = self._lib.dc_segment_size(self._handle, ctypes.c_long(seg))
+                out = np.empty(size, dtype=np.uint8)
+                rc = self._lib.dc_read(
+                    self._handle, ctypes.c_long(seg), out.ctypes.data_as(ctypes.c_void_p)
+                )
+                if rc != 0:
+                    raise IOError(f"native data cache read failed with code {rc}")
+                metrics.inc_counter("datacache.readBytes", int(size))
+                return out.view(dtype).reshape(shape)
+            metrics.inc_counter("datacache.readBytes", len(self._segments[seg]))
+            # frombuffer over the stored bytes is a READ-ONLY view; consumers
+            # that mutate in place (scalers normalizing a replayed batch,
+            # np.pad-free padding) would crash on it — copy to a writable
+            # array, matching the native path's np.empty-backed reads
+            return (
+                np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape).copy()
             )
-            if rc != 0:
-                raise IOError(f"native data cache read failed with code {rc}")
-            metrics.inc_counter("datacache.readBytes", int(size))
-            return out.view(dtype).reshape(shape)
-        metrics.inc_counter("datacache.readBytes", len(self._segments[seg]))
-        # frombuffer over the stored bytes is a READ-ONLY view; consumers
-        # that mutate in place (scalers normalizing a replayed batch,
-        # np.pad-free padding) would crash on it — copy to a writable
-        # array, matching the native path's np.empty-backed reads
-        return (
-            np.frombuffer(self._segments[seg], dtype=dtype).reshape(shape).copy()
-        )
+
+        return flow.with_retries(read, site="datacache.read")
 
     @property
     def num_segments(self) -> int:

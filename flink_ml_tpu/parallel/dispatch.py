@@ -145,6 +145,7 @@ class DrainQueue:
 
     def __init__(self, depth: int):
         self.depth = max(1, int(depth))
+        # tpulint: disable=unbounded-queue -- depth-bounded by construction: push() drains past self.depth in the same call, single-threaded
         self._q: deque = deque()
         tracing.set_dispatch_depth(self.depth)
 

@@ -30,17 +30,22 @@ runner:
    stream fit enforced separately before this module replaced them).
    Batch b+1's cache read + pack + H2D upload ride under batch b's
    compute — the overlap the reference gets from DataCacheReader on
-   Flink's async mailbox.
+   Flink's async mailbox. Since the flow-control sweep the window is a
+   `flow.BoundedChannel` (credit-based backpressure, per-consumer
+   overload policies — the online estimators run their ingest through
+   the same class with `shed_oldest`/`sample`), the worker is spawned by
+   `flow.pump` (a worker error closes the channel with the error, so it
+   re-raises at the consumer instead of silently stalling it), and every
+   stage execution is timed by a `flow.StragglerWatchdog`.
 """
 
 from __future__ import annotations
 
-from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from .. import flow
 from ..utils import metrics
 
 __all__ = [
@@ -172,39 +177,45 @@ def slice_rows(col, n: int):
 class Prefetcher:
     """Run `stage(item)` in one worker thread up to `depth` items ahead.
 
-    `iterate(items)` yields staged results strictly in input order — no
-    drops, no reordering, whatever the relative speed of producer and
-    consumer. The worker is created per iteration and torn down when the
-    generator closes (including early exits: a training loop that stops
-    on tol simply abandons the generator and the speculative staging work
-    is cancelled). `depth` defaults to `config.input_prefetch_depth`.
+    The staging window is a `flow.BoundedChannel`: with the default
+    `block` policy, `iterate(items)` yields staged results strictly in
+    input order — no drops, no reordering, whatever the relative speed of
+    producer and consumer (credit-based backpressure: the worker stalls
+    once `depth` items wait unconsumed). The online estimators pass
+    `policy="shed_oldest"`/`"sample"` for bounded-memory, tracked-
+    staleness ingest instead (see docs/flow_control.md). The worker is
+    created per iteration and torn down when the generator closes
+    (including early exits: a training loop that stops on tol simply
+    abandons the generator and the speculative staging work is
+    cancelled). An exception raised inside `stage` — or by the source
+    iterable — surfaces to the consuming iterator, re-raised at the next
+    `__next__` after the items staged before it; a dead worker can never
+    silently stall the consumer. `depth` defaults to
+    `config.input_prefetch_depth`.
     """
 
-    def __init__(self, stage: Callable[[Any], Any], depth: Optional[int] = None):
+    def __init__(
+        self,
+        stage: Callable[[Any], Any],
+        depth: Optional[int] = None,
+        policy: str = flow.BLOCK,
+        name: str = "prefetch",
+    ):
         from .. import config
 
         self.stage = stage
         self.depth = max(1, int(depth if depth is not None else config.input_prefetch_depth))
+        self.policy = policy
+        self.name = name
+        self.watchdog = flow.StragglerWatchdog(name)
+        self.channel: Optional[flow.BoundedChannel] = None  # latest iterate()'s window
 
     def iterate(self, items: Iterable) -> Iterator:
         metrics.set_gauge("prefetch.depth", self.depth)
-        it = iter(items)
-        pending: deque = deque()
-        executor = ThreadPoolExecutor(max_workers=1)
+        channel = flow.BoundedChannel(self.depth, policy=self.policy, name=self.name)
+        self.channel = channel
+        flow.pump(items, channel, transform=self.stage, watchdog=self.watchdog)
         try:
-            exhausted = False
-            while True:
-                while not exhausted and len(pending) < self.depth:
-                    item = next(it, _SENTINEL)
-                    if item is _SENTINEL:
-                        exhausted = True
-                        break
-                    pending.append(executor.submit(self.stage, item))
-                if not pending:
-                    return
-                yield pending.popleft().result()
+            yield from channel
         finally:
-            executor.shutdown(wait=False, cancel_futures=True)
-
-
-_SENTINEL = object()
+            channel.cancel()  # early exit: stop the speculative staging

@@ -1,9 +1,10 @@
-"""Micro-batch serving — double-buffered fused pipeline inference.
+"""Micro-batch serving — double-buffered, overload-graceful fused inference.
 
 The throughput path the ROADMAP north star asks for: drive a fused
 `PipelineModel` transform plan (pipeline.py) over an unbounded stream of
-mini-batches at a bounded, stage-count-independent host-sync cost. Two
-mechanisms on top of the fusion planner:
+mini-batches at a bounded, stage-count-independent host-sync cost — and
+keep that true when the offered load exceeds capacity or a dependency
+flakes. Mechanisms on top of the fusion planner:
 
 1. **Bucket padding** — a jitted segment program is specialized to its
    input shapes, so free-running batch sizes would recompile every batch.
@@ -15,38 +16,111 @@ mechanisms on top of the fusion planner:
 
 2. **Bounded in-flight window** — the transform of batch i is dispatched
    with its exit guard drain DEFERRED (PipelineModel.transform_deferred),
-   and the (output, pending-guards) pair parks in a bounded queue, the
-   DrainQueue pattern of parallel/dispatch.py. Batch i+1's H2D upload and
-   segment dispatch overlap batch i's device compute; the single blocking
-   guard readback happens only when a batch leaves the window. Per-batch
-   host syncs are therefore O(1) regardless of pipeline depth.
+   and the (output, pending-guards) pair parks in a `flow.BoundedChannel`
+   of capacity `in_flight`. Batch i+1's H2D upload and segment dispatch
+   overlap batch i's device compute; the single blocking guard readback
+   happens only when a batch leaves the window. Per-batch host syncs are
+   therefore O(1) regardless of pipeline depth.
+
+3. **Admission control + deadlines** (`submit`/`results`, the push API) —
+   an admission `BoundedChannel` with the `reject` policy in front of the
+   dispatch loop: once `admission` requests wait, `submit` fast-fails
+   with a typed `ServerOverloaded` carrying the live queue depth, so an
+   overloaded server sheds load at the door with bounded memory and
+   bounded client latency instead of growing a queue until the host
+   dies. A request may carry a deadline: expired-before-dispatch requests
+   are shed without paying compute (`serving.deadlineMiss` +
+   status `"expired"`), finished-after-deadline results deliver marked
+   `"late"`.
+
+4. **Transient-fault resilience** — batch dispatch runs under
+   `flow.with_retries` (`config.transient_retries`, the
+   `serving.batch` fault site), so a transiently-failing backend retries
+   with backoff instead of killing the stream; non-transient errors
+   surface per-request (`status "error"`), never silently dropped. A
+   `flow.StragglerWatchdog` times every dispatch and flags executions
+   beyond `config.straggler_factor`× the trailing mean. `health()`
+   returns a `ServerHealth` snapshot of all of it.
 
 Results are yielded IN ORDER. A batch's guard failure (e.g. Bucketizer
 handleInvalid='error') raises when that batch is yielded — at most
 `in_flight` batches later than the eager path would have raised, never
-reordered and never dropped.
+reordered and never dropped. When the consumer abandons `serve` early (a
+`close()`/GeneratorExit) or a deferred guard error terminates it, the
+still-in-flight window is drained and released — no staged device buffers
+or queue slots leak (`serving.cancelled` counts the released batches).
 """
 
 from __future__ import annotations
 
-from collections import deque
+import time
+from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import config
+from . import config, flow
+from .ckpt import faults
 from .obs import tracing
 from .parallel.prefetch import next_bucket, pad_rows, slice_rows, stage_to_device
 from .pipeline import PipelineModel, _drain_guards
 from .table import SparseBatch, Table
 from .utils import metrics
 
-__all__ = ["MicroBatchServer", "serve_stream"]
+__all__ = [
+    "MicroBatchServer",
+    "ServerHealth",
+    "ServerOverloaded",
+    "ServeResult",
+    "serve_stream",
+]
 
-# The bucket schedule and repeat-last-row pad now live in
+# The bucket schedule and repeat-last-row pad live in
 # parallel/prefetch.py, shared with the stream-training staging paths —
 # same policy, same guard-safety argument, one implementation.
 _next_bucket, _pad_rows, _slice_rows = next_bucket, pad_rows, slice_rows
+
+
+class ServerOverloaded(flow.ChannelRejected):
+    """`submit` fast-fail: the admission queue is full. Carries the live
+    queue depth and capacity (inherited from `flow.ChannelRejected`) so a
+    client can back off / divert instead of parsing a message."""
+
+
+@dataclass
+class ServeResult:
+    """One retired request from the push API, in submission order.
+    `status` is `"ok"`, `"late"` (finished past its deadline), `"expired"`
+    (deadline passed before dispatch — no compute paid, `table` is None)
+    or `"error"` (`error` holds the exception; the stream continues)."""
+
+    seq: int
+    status: str
+    table: Optional[Table] = None
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class ServerHealth:
+    """Point-in-time server snapshot — the serving analogue of
+    `DeviceEpochCache.stats`: every overload decision the server made,
+    queriable without scraping the metrics registry."""
+
+    inFlight: int  # window capacity
+    windowDepth: int  # transformed-but-undrained batches right now
+    admissionCapacity: int
+    admissionDepth: int  # submitted-but-undispatched requests right now
+    submitted: int  # requests accepted by submit()
+    rejected: int  # submits refused at the door (ServerOverloaded)
+    completed: int  # results delivered (any status)
+    expired: int  # shed before dispatch: deadline already passed
+    late: int  # delivered after their deadline
+    errors: int  # per-request failures delivered as status "error"
+    retries: int  # transient-fault retries paid by batch dispatch
+    cancelled: int  # in-flight batches released by an early serve() exit
+    bucketsSeen: int
+    emaBatchMs: float  # dispatch trailing-mean latency (watchdog EMA)
+    stragglers: int  # dispatches flagged beyond straggler_factor x mean
 
 
 class MicroBatchServer:
@@ -58,6 +132,18 @@ class MicroBatchServer:
     next power of two. `device_input=True` uploads each padded batch's
     numeric host columns to device HBM before dispatch, so the whole
     pipeline — upload included — runs ahead of the previous batch's drain.
+    `admission` bounds the push API's submit queue (default
+    `config.serving_admission`); `deadline_ms` is the default per-request
+    deadline (None = none); `retries` the transient-fault retry budget for
+    batch dispatch (default `config.transient_retries`).
+
+    Two consumption styles:
+
+    - `serve(stream)` — the pull loop: the caller owns pacing, the window
+      gives lossless credit-based backpressure (the `block` policy).
+    - `submit(batch)` + `results()` — the push loop: a dispatch worker
+      consumes an admission queue with the `reject` policy; `submit`
+      raises `ServerOverloaded` once `admission` requests wait.
     """
 
     def __init__(
@@ -66,6 +152,9 @@ class MicroBatchServer:
         in_flight: Optional[int] = None,
         buckets: Optional[Sequence[int]] = None,
         device_input: bool = True,
+        admission: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        retries: Optional[int] = None,
     ):
         if not isinstance(model, PipelineModel):
             raise TypeError(f"MicroBatchServer serves a PipelineModel, got {type(model).__name__}")
@@ -73,7 +162,26 @@ class MicroBatchServer:
         self.in_flight = max(1, int(in_flight if in_flight is not None else config.serving_in_flight))
         self.buckets = sorted(int(b) for b in buckets) if buckets else None
         self.device_input = device_input
+        self.admission = max(
+            1, int(admission if admission is not None else config.serving_admission)
+        )
+        self.deadline_ms = deadline_ms if deadline_ms is not None else config.serving_deadline_ms
+        self.retries = retries
+        self.watchdog = flow.StragglerWatchdog("serving.batch")
         self._buckets_seen: set = set()
+        self._counts: Dict[str, int] = {
+            "completed": 0,
+            "expired": 0,
+            "late": 0,
+            "errors": 0,
+            "retries": 0,
+            "cancelled": 0,
+        }
+        self._window: Optional[flow.BoundedChannel] = None  # latest serve window
+        self._requests: Optional[flow.BoundedChannel] = None
+        self._out: Optional[flow.BoundedChannel] = None
+        self._worker = None
+        self._seq = 0
 
     # -- batch staging -------------------------------------------------------
     def _stage_batch(self, batch: Table) -> Tuple[Table, int]:
@@ -111,6 +219,31 @@ class MicroBatchServer:
             and col.dtype.kind not in ("U", "S")
         )
 
+    def _dispatch(self, batch: Table, index: int):
+        """Stage + dispatch one batch under the transient-retry budget
+        and the straggler watchdog. The `serving.batch` fault site sits
+        inside the retried unit, so a `faults.flaky` plan exercises the
+        retry path end to end; staging re-runs with the dispatch (an
+        upload that failed mid-flight cannot be trusted half-done)."""
+
+        def attempt():
+            faults.tick("serving.batch")
+            staged, n = self._stage_batch(batch)
+            out, pending = self.model.transform_deferred(staged)
+            return out, pending, n
+
+        with tracing.span("serving.batch", index=index, op="dispatch"):
+            with self.watchdog.observe():
+                return flow.with_retries(
+                    attempt,
+                    site="serving.batch",
+                    retries=self.retries,
+                    on_retry=lambda e, a: self._count("retries"),
+                )
+
+    def _count(self, key: str, n: int = 1) -> None:
+        self._counts[key] = self._counts.get(key, 0) + n
+
     def _finish(self, out: Table, pending: List[Tuple[str, Any]], n: int) -> Table:
         """Retire one batch from the in-flight window: ONE packed guard
         readback (the batch's only blocking sync), then slice the padding
@@ -120,30 +253,174 @@ class MicroBatchServer:
             return out
         return Table({name: _slice_rows(out.column(name), n) for name in out.column_names})
 
-    # -- the serving loop ----------------------------------------------------
+    def _release(self, window: flow.BoundedChannel) -> None:
+        """Early-exit cleanup: drop every still-in-flight batch — staged
+        device buffers and pending guard handles release with their
+        references, and the window's queue slots free — so an abandoned
+        serve() (consumer close, deferred-guard error) leaks nothing.
+        The abandoned guards are never drained: raising NEW errors out of
+        a generator teardown would mask the one the consumer saw."""
+        leaked = window.cancel()
+        if leaked:
+            metrics.inc_counter("serving.cancelled", len(leaked))
+            self._count("cancelled", len(leaked))
+        metrics.set_gauge("serving.buckets", len(self._buckets_seen))
+
+    # -- the pull serving loop ----------------------------------------------
     def serve(self, stream: Iterable[Table]) -> Iterator[Table]:
         """Transform every batch of `stream`, yielding output Tables in
         input order. Output columns may be device-resident; callers that
         need host values materialize them (that readback is theirs)."""
-        window: deque = deque()
+        window = flow.BoundedChannel(self.in_flight, policy=flow.BLOCK, name="serving.window")
+        self._window = window
         num_batches = 0
-        num_records = 0
         metrics.set_gauge("serving.in_flight", self.in_flight)
-        for batch in stream:
-            with tracing.span("serving.batch", index=num_batches, op="dispatch"):
-                staged, n = self._stage_batch(batch)
-                out, pending = self.model.transform_deferred(staged)
-            window.append((out, pending, n))
-            num_batches += 1
-            num_records += n
-            metrics.inc_counter("serving.batches")
-            metrics.inc_counter("serving.records", n)
-            if len(window) > self.in_flight:
-                yield self._finish(*window.popleft())
-            metrics.set_gauge("serving.buckets", len(self._buckets_seen))
-        while window:
-            yield self._finish(*window.popleft())
-        metrics.set_gauge("serving.buckets", len(self._buckets_seen))
+        try:
+            for batch in stream:
+                entry = self._dispatch(batch, num_batches)
+                if not window.offer(entry):  # window full: retire the oldest
+                    yield self._finish(*window.get())
+                    window.offer(entry)
+                num_batches += 1
+                metrics.inc_counter("serving.batches")
+                metrics.inc_counter("serving.records", entry[2])
+                metrics.set_gauge("serving.buckets", len(self._buckets_seen))
+            while len(window):
+                yield self._finish(*window.get())
+        finally:
+            self._release(window)
+
+    # -- the push serving loop: admission control + deadlines ----------------
+    def start(self) -> None:
+        """Bring up the dispatch worker and its channels (idempotent;
+        `submit` auto-starts)."""
+        if self._worker is not None:
+            return
+        self._requests = flow.BoundedChannel(
+            self.admission, policy=flow.REJECT, name="serving.admit"
+        )
+        # results buffer: sized so a retired batch never blocks the worker
+        # while the admission queue and window both stay full — the
+        # consumer's pull pace backpressures through it
+        self._out = flow.BoundedChannel(
+            self.admission + self.in_flight + 1, policy=flow.BLOCK, name="serving.results"
+        )
+        metrics.set_gauge("serving.in_flight", self.in_flight)
+        self._worker = flow.spawn(self._run, name="serving.dispatch")
+
+    def submit(self, batch: Table, deadline_ms: Optional[float] = None) -> int:
+        """Admit one batch, returning its sequence number. Raises
+        `ServerOverloaded` (with the live queue depth) when `admission`
+        requests already wait — the typed fast-fail of the `reject`
+        policy. `deadline_ms` overrides the server default."""
+        if self._worker is None:
+            self.start()
+        ms = deadline_ms if deadline_ms is not None else self.deadline_ms
+        deadline = None if ms is None else time.monotonic() + ms / 1000.0
+        seq = self._seq
+        try:
+            self._requests.put((seq, batch, deadline))
+        except flow.ChannelRejected as e:
+            metrics.inc_counter("serving.rejected")
+            raise ServerOverloaded(e.channel, e.depth, e.capacity) from None
+        self._seq += 1
+        metrics.inc_counter("serving.batches")
+        metrics.inc_counter("serving.records", batch.num_rows)
+        return seq
+
+    def close(self) -> None:
+        """No more submits; the worker drains what was admitted and closes
+        the results stream."""
+        if self._requests is not None:
+            self._requests.close()
+
+    def results(self) -> Iterator[ServeResult]:
+        """Retired requests in submission order (`ServeResult`); ends when
+        `close()` has been called and every admitted request retired."""
+        if self._worker is None:
+            self.start()
+        yield from self._out
+
+    def health(self) -> ServerHealth:
+        """A `ServerHealth` snapshot of queues, overload decisions, retry
+        spend and dispatch latency."""
+        window_depth = len(self._window) if self._window is not None else 0
+        adm_depth = len(self._requests) if self._requests is not None else 0
+        rejected = (
+            self._requests.stats.rejected if self._requests is not None else 0
+        )
+        submitted = self._requests.stats.puts if self._requests is not None else 0
+        return ServerHealth(
+            inFlight=self.in_flight,
+            windowDepth=window_depth,
+            admissionCapacity=self.admission,
+            admissionDepth=adm_depth,
+            submitted=submitted,
+            rejected=rejected,
+            completed=self._counts["completed"],
+            expired=self._counts["expired"],
+            late=self._counts["late"],
+            errors=self._counts["errors"],
+            retries=self._counts["retries"],
+            cancelled=self._counts["cancelled"],
+            bucketsSeen=len(self._buckets_seen),
+            emaBatchMs=self.watchdog.trailing_mean_s * 1000.0,
+            stragglers=metrics.get_counter("flow.straggler.serving.batch", 0),
+        )
+
+    def _run(self) -> None:
+        """Dispatch worker: admission queue → window → results, deadlines
+        enforced at both ends. Any worker-level failure closes the results
+        channel with the error — consumers re-raise instead of hanging."""
+        window = flow.BoundedChannel(self.in_flight, policy=flow.BLOCK, name="serving.window")
+        self._window = window
+        try:
+            for seq, batch, deadline in self._requests:
+                if deadline is not None and time.monotonic() > deadline:
+                    # shed BEFORE paying staging/compute: the client
+                    # already gave up on this request
+                    metrics.inc_counter("serving.deadlineMiss")
+                    self._count("expired")
+                    self._emit(ServeResult(seq, "expired"))
+                    continue
+                try:
+                    entry = self._dispatch(batch, seq)
+                except Exception as e:  # per-request failure: stream survives
+                    self._count("errors")
+                    self._emit(ServeResult(seq, "error", error=e))
+                    continue
+                if not window.offer((seq, deadline) + entry):
+                    self._retire(window.get())
+                    window.offer((seq, deadline) + entry)
+            while len(window):
+                self._retire(window.get())
+            self._out.close()
+        except BaseException as e:  # worker death must not strand consumers
+            self._out.close(error=e)
+        finally:
+            self._release(window)
+
+    def _retire(self, entry) -> None:
+        seq, deadline, out, pending, n = entry
+        try:
+            table = self._finish(out, pending, n)
+        except Exception as e:  # deferred guard error: per-request, in order
+            self._count("errors")
+            self._emit(ServeResult(seq, "error", error=e))
+            return
+        status = "ok"
+        if deadline is not None and time.monotonic() > deadline:
+            metrics.inc_counter("serving.deadlineMiss")
+            self._count("late")
+            status = "late"
+        self._emit(ServeResult(seq, status, table=table))
+
+    def _emit(self, result: ServeResult) -> None:
+        self._count("completed")
+        try:
+            self._out.put(result)
+        except flow.ChannelClosed:  # consumer cancelled results(): drop
+            pass
 
 
 def serve_stream(

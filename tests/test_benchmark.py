@@ -91,8 +91,13 @@ class TestRunner:
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
+            "retryCount", "shedCount", "rejectCount", "peakQueueDepth",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
+        # flow-control fields: a clean run pays no retries/sheds/rejects
+        assert result["retryCount"] == 0
+        assert result["shedCount"] == 0
+        assert result["rejectCount"] == 0
         assert set(result["phaseTimesMs"]) == {"datagen", "fit", "transform", "collect"}
         assert result["inputRecordNum"] == 200
         assert result["totalTimeMs"] > 0

@@ -278,3 +278,133 @@ def test_elastic_kill_resume_across_device_counts(tmp_path, from_dev, to_dev):
     np.testing.assert_allclose(
         np.asarray(killed_coeff), np.asarray(single_coeff), rtol=3e-5, atol=3e-6
     )
+
+
+# ---------------------------------------------------------------------------
+# flaky (transient) snapshot I/O: the retry paths under injection
+# (flow.with_retries + ckpt.faults.flaky — docs/flow_control.md)
+# ---------------------------------------------------------------------------
+
+def _save_snap(path, epoch, scale=1.0, key="flaky"):
+    from flink_ml_tpu.ckpt import snapshot as snap
+
+    return snap.save_job_snapshot(
+        str(path), key,
+        {"model": (np.full(4, scale, np.float64), np.arange(4, dtype=np.float32))},
+        epoch=epoch,
+    )
+
+
+def _load_snap(path, key="flaky"):
+    from flink_ml_tpu.ckpt import snapshot as snap
+
+    return snap.load_job_snapshot(
+        str(path), key,
+        templates={"model": (np.zeros(4), np.zeros(4, np.float32))},
+    )
+
+
+def test_flaky_snapshot_read_retried_to_success(tmp_path):
+    """A restore that hits a transiently-failing read retries through the
+    budget and still returns the snapshot."""
+    from flink_ml_tpu.utils import metrics
+
+    _save_snap(tmp_path, epoch=5)
+    before = metrics.get_counter("flow.retry.snapshot.read", 0)
+    with config.transient_retry_mode(3):
+        with faults.flaky("snapshot.read", times=2) as plan:
+            got = _load_snap(tmp_path)
+    assert plan.failures == 2
+    assert got is not None and got.epoch == 5
+    np.testing.assert_array_equal(got.sections["model"][0], np.full(4, 1.0))
+    assert metrics.get_counter("flow.retry.snapshot.read", 0) == before + 2
+
+
+def test_flaky_snapshot_read_budget_exhausted_reraises_original(tmp_path):
+    """An exhausted retry budget re-raises the ORIGINAL TransientFault —
+    not a wrapper — with the attempt count attached as evidence."""
+    from flink_ml_tpu.ckpt.faults import TransientFault
+
+    _save_snap(tmp_path, epoch=3)
+    with config.transient_retry_mode(2):
+        with faults.flaky("snapshot.read", times=10):
+            with pytest.raises(TransientFault) as ei:
+                _load_snap(tmp_path)
+    assert ei.value.site == "snapshot.read"  # the original error object
+    assert ei.value.retry_attempts == 3  # 1 try + 2 retries
+
+
+def test_flaky_snapshot_write_retried_then_readable(tmp_path):
+    from flink_ml_tpu.ckpt.faults import TransientFault
+
+    with config.transient_retry_mode(3):
+        with faults.flaky("snapshot.write", times=2) as plan:
+            _save_snap(tmp_path, epoch=7, scale=2.5)
+    assert plan.failures == 2
+    got = _load_snap(tmp_path)
+    assert got.epoch == 7
+    np.testing.assert_array_equal(got.sections["model"][0], np.full(4, 2.5))
+    # budget exhausted: the original fault surfaces
+    with config.transient_retry_mode(1):
+        with faults.flaky("snapshot.write", times=5):
+            with pytest.raises(TransientFault) as ei:
+                _save_snap(tmp_path, epoch=8)
+    assert ei.value.retry_attempts == 2
+
+
+def test_midwrite_kill_then_flaky_reads_still_restore_previous(tmp_path):
+    """The composed failure: a crash mid-checkpoint (torn write — temp
+    file written, commit rename never ran) followed by transiently-failing
+    reads on restart. The previous snapshot must still restore, through
+    the retries."""
+    _save_snap(tmp_path, epoch=4, scale=1.0)
+    with faults.inject("snapshot.write", after=1):
+        with pytest.raises(InjectedFault):
+            _save_snap(tmp_path, epoch=9, scale=9.0)  # dies before commit
+    with config.transient_retry_mode(3):
+        with faults.flaky("snapshot.read", times=2) as plan:
+            got = _load_snap(tmp_path)
+    assert plan.failures == 2
+    assert got is not None and got.epoch == 4, "torn write must not be visible"
+    np.testing.assert_array_equal(got.sections["model"][0], np.full(4, 1.0))
+
+
+def test_injected_write_kill_not_retried(tmp_path):
+    """InjectedFault models a crash: the snapshot-write retry wrapper must
+    let it through on the FIRST hit, whatever the budget."""
+    with config.transient_retry_mode(10):
+        with faults.inject("snapshot.write", after=1) as plan:
+            with pytest.raises(InjectedFault):
+                _save_snap(tmp_path, epoch=1)
+    assert plan.hits == 1  # one attempt: the kill was not swallowed/retried
+
+
+def test_flaky_datacache_read_inside_stream_fit_bit_identical(tmp_path):
+    """Transient spill-read faults under the retry budget are invisible
+    to an out-of-core fit's result; with the budget at 0 the same fault
+    is fatal (the pre-flow behavior)."""
+    from flink_ml_tpu.ckpt.faults import TransientFault
+
+    X, y = _dense_problem(n=480, seed=6)
+
+    def chunks():
+        return iter(
+            [(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)]
+        )
+
+    clean, _, _, _ = _sgd(max_iter=6).optimize_stream(
+        None, chunks(), BINARY_LOGISTIC_LOSS
+    )
+    with config.transient_retry_mode(4):
+        with faults.flaky("datacache.read", times=3) as plan:
+            got, _, _, _ = _sgd(max_iter=6).optimize_stream(
+                None, chunks(), BINARY_LOGISTIC_LOSS
+            )
+    assert plan.failures == 3
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(clean))
+    with config.transient_retry_mode(0):
+        with faults.flaky("datacache.read", times=1):
+            with pytest.raises(TransientFault):
+                _sgd(max_iter=6).optimize_stream(
+                    None, chunks(), BINARY_LOGISTIC_LOSS
+                )

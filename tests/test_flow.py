@@ -1,0 +1,361 @@
+"""flow.py battery — the flow-control and transient-fault contracts.
+
+Pins: credit-based BoundedChannel semantics per overload policy (block =
+lossless in-order backpressure; shed_oldest = bounded memory AND bounded
+staleness; sample = bounded memory, prefix sample; reject = typed
+fast-fail with live depth), in-order error propagation from a pump worker
+(a dead producer can never stall a blocked consumer), with_retries'
+taxonomy (transient-only, original error re-raised with attempt count,
+deadline/budget bounds), the flaky fault mode that makes retry paths
+injectable, and the straggler watchdog's counters.
+"""
+
+import time
+
+import pytest
+
+from flink_ml_tpu import config, flow
+from flink_ml_tpu.ckpt import faults
+from flink_ml_tpu.ckpt.faults import InjectedFault, TransientFault
+from flink_ml_tpu.utils import metrics
+
+
+# ---------------------------------------------------------------------------
+# BoundedChannel policies
+# ---------------------------------------------------------------------------
+
+class TestBoundedChannel:
+    def test_block_policy_lossless_in_order(self):
+        chan = flow.BoundedChannel(3, name="t.block")
+        flow.pump(range(50), chan, transform=lambda i: i * i)
+        assert list(chan) == [i * i for i in range(50)]
+        assert chan.stats.puts == 50 and chan.stats.gets == 50
+        assert chan.stats.shed == 0 and chan.stats.rejected == 0
+        assert chan.stats.peak_depth <= 3
+
+    def test_block_policy_backpressures_producer(self):
+        """The producer cannot run more than `capacity` items ahead."""
+        staged = []
+        chan = flow.BoundedChannel(2, name="t.credit")
+        flow.pump(range(100), chan, transform=lambda i: staged.append(i) or i)
+        assert chan.get() == 0
+        time.sleep(0.05)
+        # consumed 1; at most capacity staged beyond it + 1 in flight
+        assert len(staged) <= 1 + 2 + 1
+        assert chan.credits() >= 0
+        chan.cancel()
+
+    def test_shed_oldest_bounds_memory_and_staleness(self):
+        capacity = 4
+        chan = flow.BoundedChannel(capacity, policy=flow.SHED_OLDEST, name="t.shed")
+        for burst in range(8):
+            for i in range(capacity * 25):
+                assert chan.put(burst * 100 + i)
+            chan.get()
+        assert len(chan) <= capacity
+        assert chan.stats.shed > 0
+        # the staleness contract: a consumed item is always one of the
+        # newest `capacity` accepted at its dequeue instant
+        assert chan.stats.max_lag < capacity
+
+    def test_sample_policy_keeps_prefix(self):
+        chan = flow.BoundedChannel(2, policy=flow.SAMPLE, name="t.sample")
+        assert chan.put("a") and chan.put("b")
+        assert not chan.put("c")  # dropped, queue keeps the prefix
+        assert chan.stats.shed == 1
+        assert chan.get() == "a" and chan.get() == "b"
+
+    def test_reject_policy_typed_fast_fail_with_depth(self):
+        chan = flow.BoundedChannel(2, policy=flow.REJECT, name="t.reject")
+        chan.put(1)
+        chan.put(2)
+        with pytest.raises(flow.ChannelRejected) as ei:
+            chan.put(3)
+        assert ei.value.depth == 2 and ei.value.capacity == 2
+        assert ei.value.channel == "t.reject"
+        assert chan.stats.rejected == 1
+        # a freed credit re-admits
+        chan.get()
+        assert chan.put(3)
+
+    def test_put_get_timeouts(self):
+        chan = flow.BoundedChannel(1, name="t.timeout")
+        with pytest.raises(TimeoutError):
+            chan.get(timeout=0.01)
+        chan.put("x")
+        with pytest.raises(TimeoutError):
+            chan.put("y", timeout=0.01)
+
+    def test_close_then_drain_then_stop(self):
+        chan = flow.BoundedChannel(4, name="t.close")
+        chan.put(1)
+        chan.put(2)
+        chan.close()
+        assert chan.get() == 1 and chan.get() == 2
+        with pytest.raises(flow.ChannelClosed):
+            chan.get()
+        with pytest.raises(flow.ChannelClosed):
+            chan.put(3)
+
+    def test_cancel_returns_queued_items(self):
+        chan = flow.BoundedChannel(4, name="t.cancel")
+        chan.put("a")
+        chan.put("b")
+        assert chan.cancel() == ["a", "b"]
+        assert len(chan) == 0
+
+    def test_error_delivered_in_order_after_staged_items(self):
+        chan = flow.BoundedChannel(8, name="t.err")
+        chan.put(1)
+        chan.close(error=RuntimeError("boom"))
+        assert chan.get() == 1  # staged-before-failure items deliver first
+        with pytest.raises(RuntimeError, match="boom"):
+            chan.get()
+
+    def test_metrics_counters(self):
+        before_shed = metrics.get_counter("flow.shed", 0)
+        before_rej = metrics.get_counter("flow.reject", 0)
+        chan = flow.BoundedChannel(1, policy=flow.SHED_OLDEST, name="t.metrics")
+        chan.put(1)
+        chan.put(2)
+        assert metrics.get_counter("flow.shed", 0) == before_shed + 1
+        chan2 = flow.BoundedChannel(1, policy=flow.REJECT, name="t.metrics2")
+        chan2.put(1)
+        with pytest.raises(flow.ChannelRejected):
+            chan2.put(2)
+        assert metrics.get_counter("flow.reject", 0) == before_rej + 1
+        assert metrics.get_gauge("flow.peakQueueDepth", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# pump: worker lifecycle + error propagation
+# ---------------------------------------------------------------------------
+
+class TestPump:
+    def test_source_error_propagates_not_stalls(self):
+        def items():
+            yield 1
+            yield 2
+            raise OSError("source died")
+
+        chan = flow.BoundedChannel(8, name="p.err")
+        flow.pump(items(), chan)
+        got = []
+        with pytest.raises(OSError, match="source died"):
+            for x in chan:
+                got.append(x)
+        assert got == [1, 2]
+
+    def test_transform_error_propagates(self):
+        chan = flow.BoundedChannel(8, name="p.terr")
+        flow.pump(range(10), chan, transform=lambda i: 1 // (3 - i) and i)
+        with pytest.raises(ZeroDivisionError):
+            list(chan)
+
+    def test_consumer_cancel_stops_producer(self):
+        staged = []
+
+        def stage(i):
+            staged.append(i)
+            return i
+
+        chan = flow.BoundedChannel(2, name="p.cancel")
+        worker = flow.pump(range(1000), chan, transform=stage)
+        assert chan.get() == 0
+        chan.cancel()
+        worker.join(timeout=5.0)
+        assert not worker.is_alive()
+        assert len(staged) <= 6  # bounded speculation, no runaway staging
+
+    def test_worker_completes_before_clean_exhaustion(self):
+        chan = flow.BoundedChannel(4, name="p.done")
+        worker = flow.pump(range(5), chan)
+        assert list(chan) == list(range(5))
+        worker.join(timeout=5.0)
+        assert not worker.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# with_retries: taxonomy, budget, deadline
+# ---------------------------------------------------------------------------
+
+class TestWithRetries:
+    def test_transient_retried_to_success(self):
+        calls = {"n": 0}
+
+        def flaky_fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise flow.TransientError("blip")
+            return "ok"
+
+        before = metrics.get_counter("flow.retry", 0)
+        assert flow.with_retries(flaky_fn, retries=5, base_delay_s=1e-4) == "ok"
+        assert calls["n"] == 3
+        assert metrics.get_counter("flow.retry", 0) == before + 2
+
+    def test_budget_exhaustion_reraises_original_with_attempts(self):
+        err = flow.TransientError("persistent")
+
+        def always():
+            raise err
+
+        with pytest.raises(flow.TransientError) as ei:
+            flow.with_retries(always, retries=2, base_delay_s=1e-4)
+        assert ei.value is err  # the ORIGINAL error, not a wrapper
+        assert ei.value.retry_attempts == 3  # 1 try + 2 retries
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def data_error():
+            calls["n"] += 1
+            raise ValueError("bad data")
+
+        with pytest.raises(ValueError):
+            flow.with_retries(data_error, retries=5)
+        assert calls["n"] == 1
+
+    def test_injected_fault_is_a_crash_not_a_blip(self):
+        """InjectedFault models a kill: a retry wrapper must NOT eat it,
+        whatever the budget — retrying a crash would un-test resume."""
+        calls = {"n": 0}
+
+        def killed():
+            calls["n"] += 1
+            raise InjectedFault("site", 1)
+
+        with pytest.raises(InjectedFault):
+            flow.with_retries(killed, retries=10)
+        assert calls["n"] == 1
+
+    def test_zero_budget_is_fail_fast(self):
+        with config.transient_retry_mode(0):
+            with pytest.raises(flow.TransientError):
+                flow.with_retries(
+                    lambda: (_ for _ in ()).throw(flow.TransientError("x"))
+                )
+
+    def test_deadline_bounds_total_time(self):
+        def always():
+            raise flow.TransientError("slow")
+
+        t0 = time.perf_counter()
+        with pytest.raises(flow.TransientError) as ei:
+            flow.with_retries(
+                always, retries=10_000, base_delay_s=0.02, deadline_s=0.05
+            )
+        assert time.perf_counter() - t0 < 2.0
+        assert ei.value.retry_attempts < 10_000
+
+    def test_oserror_is_transient(self):
+        calls = {"n": 0}
+
+        def io():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("fs blip")
+            return 7
+
+        assert flow.with_retries(io, retries=2, base_delay_s=1e-4) == 7
+
+
+# ---------------------------------------------------------------------------
+# flaky fault mode (ckpt/faults.py) — the injectable transient
+# ---------------------------------------------------------------------------
+
+class TestFlakyFaults:
+    def test_flaky_fails_n_times_then_succeeds(self):
+        with faults.flaky("soak.site", times=2) as plan:
+            for expected_fail in (True, True, False, False):
+                if expected_fail:
+                    with pytest.raises(TransientFault):
+                        faults.tick("soak.site")
+                else:
+                    faults.tick("soak.site")
+            assert plan.failures == 2 and plan.hits == 4
+
+    def test_transient_fault_is_retryable_injected_is_not(self):
+        assert issubclass(TransientFault, flow.TransientError)
+        assert not issubclass(InjectedFault, flow.TransientError)
+        with faults.flaky("retry.site", times=2):
+            assert flow.with_retries(
+                lambda: faults.tick("retry.site") or "ok",
+                retries=3,
+                base_delay_s=1e-4,
+            ) == "ok"
+
+    def test_flaky_and_inject_coexist(self):
+        """A flaky plan and a fatal plan on different sites don't shadow
+        each other — the mid-write-kill-then-flaky-read scenario."""
+        with faults.inject("fatal.site", after=1):
+            with faults.flaky("blip.site", times=1):
+                with pytest.raises(TransientFault):
+                    faults.tick("blip.site")
+                with pytest.raises(InjectedFault):
+                    faults.tick("fatal.site")
+
+    def test_unmatched_site_passes(self):
+        with faults.flaky("somewhere", times=5):
+            faults.tick("elsewhere")  # no raise
+
+
+# ---------------------------------------------------------------------------
+# straggler watchdog
+# ---------------------------------------------------------------------------
+
+class TestStragglerWatchdog:
+    def test_flags_beyond_factor_of_trailing_mean(self):
+        wd = flow.StragglerWatchdog("t.stage", factor=3.0, warmup=3)
+        before = metrics.get_counter("flow.straggler.t.stage", 0)
+        for _ in range(5):
+            assert not wd.record(0.010)
+        assert wd.record(0.050)  # 5x the trailing mean
+        assert metrics.get_counter("flow.straggler.t.stage", 0) == before + 1
+        assert metrics.get_gauge("flow.straggler.t.stage.lastMs") == pytest.approx(50.0)
+
+    def test_warmup_never_flags(self):
+        wd = flow.StragglerWatchdog("t.warm", factor=2.0, warmup=10)
+        assert not any(wd.record(t) for t in (0.001, 0.5, 0.001, 0.9))
+
+    def test_mean_adapts_to_new_normal(self):
+        """A stage that got permanently slower stops being flagged once
+        the EMA catches up."""
+        wd = flow.StragglerWatchdog("t.adapt", factor=3.0, warmup=2, alpha=0.5)
+        for _ in range(4):
+            wd.record(0.01)
+        assert wd.record(0.2)  # the jump is flagged
+        for _ in range(6):
+            wd.record(0.2)
+        assert not wd.record(0.2)  # the new normal is not
+
+    def test_observe_context_manager(self):
+        wd = flow.StragglerWatchdog("t.obs", warmup=1)
+        with wd.observe():
+            pass
+        assert wd.trailing_mean_s >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_overload_mode_scoped(self):
+        assert config.online_overload_policy == "block"
+        with config.online_overload_mode("shed_oldest"):
+            assert config.online_overload_policy == "shed_oldest"
+        assert config.online_overload_policy == "block"
+        with pytest.raises(ValueError):
+            with config.online_overload_mode("nope"):
+                pass
+
+    def test_retry_mode_scoped(self):
+        prev = config.transient_retries
+        with config.transient_retry_mode(7):
+            assert config.transient_retries == 7
+        assert config.transient_retries == prev
+
+    def test_unknown_policy_rejected_by_channel(self):
+        with pytest.raises(ValueError):
+            flow.BoundedChannel(2, policy="nope")

@@ -222,6 +222,65 @@ class TestPrefetcher:
         list(prefetch.Prefetcher(lambda i: i, depth=3).iterate(range(2)))
         assert metrics.get_gauge("prefetch.depth") == 3
 
+    def test_raising_stage_surfaces_to_consumer(self):
+        """The worker-error contract: an exception inside the stage
+        callable re-raises at the consuming iterator (after the items
+        staged before it, in order) instead of silently terminating the
+        worker and stalling the consumer forever."""
+        def stage(i):
+            if i == 3:
+                raise RuntimeError("stage died on item 3")
+            return i * 10
+
+        got = []
+        it = prefetch.Prefetcher(stage, depth=2).iterate(range(10))
+        with pytest.raises(RuntimeError, match="stage died on item 3"):
+            for x in it:
+                got.append(x)
+        assert got == [0, 10, 20], "items staged before the failure deliver first"
+
+    def test_raising_source_surfaces_to_consumer(self):
+        def items():
+            yield 1
+            raise OSError("source died")
+
+        with pytest.raises(OSError, match="source died"):
+            list(prefetch.Prefetcher(lambda x: x, depth=2).iterate(items()))
+
+    def test_raising_stage_does_not_hang_blocked_consumer(self):
+        """Regression for the stall mode: the consumer is already blocked
+        in __next__ when the worker dies — the error must wake it."""
+        import threading
+
+        def stage(i):
+            if i == 0:
+                time.sleep(0.05)  # consumer blocks on item 0 first
+                raise RuntimeError("died while consumer waits")
+            return i
+
+        outcome = {}
+
+        def consume():
+            try:
+                list(prefetch.Prefetcher(stage, depth=2).iterate(range(5)))
+            except RuntimeError as e:
+                outcome["error"] = str(e)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "consumer stalled on a dead worker"
+        assert outcome["error"] == "died while consumer waits"
+
+    def test_loader_stage_error_surfaces(self):
+        """CachedEpochLoader shares the same contract through its pump."""
+        def stage(k):
+            raise ValueError(f"cannot stage {k}")
+
+        loader = CachedEpochLoader(stage, cache=DeviceEpochCache(0))
+        with pytest.raises(ValueError, match="cannot stage 0"):
+            list(loader.epoch(range(3)))
+
 
 class TestDeviceEpochCache:
     def test_lru_eviction_and_counters(self):

@@ -200,3 +200,155 @@ def test_empty_stream_and_empty_batch():
 def test_server_rejects_non_pipeline():
     with pytest.raises(TypeError):
         MicroBatchServer(object())
+
+
+# ---------------------------------------------------------------------------
+# flow-control sweep: early-exit cleanup, admission, deadlines, retries
+# ---------------------------------------------------------------------------
+
+def test_early_termination_releases_window():
+    """A consumer that stops after 2 batches must not leak the staged
+    in-flight window: closing the generator drains/releases every pending
+    batch and frees its queue slots (serving.cancelled counts them)."""
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=3)
+    stream = StreamTable.from_batches(_batches([4, 4, 4, 4, 4, 4]))
+    before = metrics.get_counter("serving.cancelled", 0)
+    got = []
+    it = server.serve(stream)
+    for out in it:
+        got.append(out)
+        if len(got) == 2:
+            break
+    it.close()  # the consumer walks away mid-stream
+    assert len(got) == 2
+    assert server._window is not None and len(server._window) == 0, (
+        "in-flight batches leaked past generator close"
+    )
+    assert server._window.closed
+    released = metrics.get_counter("serving.cancelled", 0) - before
+    assert released > 0, "the pending window must be accounted as released"
+    assert server.health().cancelled == released
+
+
+def test_deferred_guard_error_releases_window():
+    """When a deferred guard error terminates serve(), the batches still
+    parked behind the failing one are released too — no staged buffers or
+    slots survive the raise."""
+    from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+
+    stage = (
+        Bucketizer()
+        .set_input_cols("a")
+        .set_output_cols("oa")
+        .set_splits_array([[0.0, 1.0, 2.0]])
+    )
+    pm = PipelineModel([stage])
+    good = Table({"a": np.array([0.5, 1.5], dtype=np.float32)})
+    bad = Table({"a": np.array([0.5, 99.0], dtype=np.float32)})
+    server = MicroBatchServer(pm, in_flight=3)
+    with pytest.raises(ValueError, match="invalid value"):
+        # bad retires first in the drain loop; good batches queue behind it
+        list(server.serve(StreamTable.from_batches([bad, good, good])))
+    assert len(server._window) == 0 and server._window.closed
+
+
+def test_submit_rejects_when_admission_full():
+    """The push API's admission control: a burst beyond the admission
+    queue fast-fails with a typed ServerOverloaded carrying the live
+    depth — bounded memory instead of grow-until-OOM."""
+    from flink_ml_tpu.serving import ServerOverloaded
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=2, admission=3)
+    submitted, rejected = 0, 0
+    for _ in range(40):
+        try:
+            server.submit(Table({"features": RNG.randn(8, 4).astype(np.float32)}))
+            submitted += 1
+        except ServerOverloaded as e:
+            rejected += 1
+            assert e.depth <= e.capacity == 3
+    server.close()
+    results = list(server.results())
+    assert len(results) == submitted, "every admitted request must retire"
+    assert [r.seq for r in results] == sorted(r.seq for r in results)
+    assert rejected > 0, "an unpaced 40-burst must overflow admission=3"
+    h = server.health()
+    assert h.rejected == rejected and h.submitted == submitted
+    assert server._requests.stats.peak_depth <= 3
+    assert server._window.stats.peak_depth <= 2
+
+
+def test_submit_deadline_expires_before_dispatch():
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=2, admission=8)
+    seqs = [
+        server.submit(
+            Table({"features": RNG.randn(8, 4).astype(np.float32)}), deadline_ms=0.0
+        )
+        for _ in range(3)
+    ]
+    server.close()
+    results = {r.seq: r for r in server.results()}
+    assert set(results) == set(seqs)
+    assert all(r.status in ("expired", "late") for r in results.values())
+    h = server.health()
+    assert h.expired + h.late == 3
+    assert metrics.get_counter("serving.deadlineMiss", 0) >= 3
+
+
+def test_push_per_request_error_does_not_kill_stream():
+    """One bad batch surfaces as a status='error' result; later requests
+    still retire ok."""
+    from flink_ml_tpu.models.feature.bucketizer import Bucketizer
+
+    stage = (
+        Bucketizer()
+        .set_input_cols("a")
+        .set_output_cols("oa")
+        .set_splits_array([[0.0, 1.0, 2.0]])
+    )
+    pm = PipelineModel([stage])
+    server = MicroBatchServer(pm, in_flight=2, admission=8)
+    server.submit(Table({"a": np.array([0.5, 1.5], dtype=np.float32)}))
+    server.submit(Table({"a": np.array([0.5, 99.0], dtype=np.float32)}))  # guard fires
+    server.submit(Table({"a": np.array([1.5, 0.5], dtype=np.float32)}))
+    server.close()
+    results = list(server.results())
+    assert [r.status for r in results] == ["ok", "error", "ok"]
+    assert isinstance(results[1].error, ValueError)
+    assert server.health().errors == 1
+
+
+def test_serving_batch_transient_fault_retried_bit_identical():
+    """A flaky batch dispatch under the retry budget is invisible to the
+    results; with the budget at 0 the same fault is fatal."""
+    from flink_ml_tpu.ckpt import faults
+    from flink_ml_tpu.ckpt.faults import TransientFault
+
+    pm = _scaler_pipeline()
+    batches = _batches([5, 9, 7])
+    clean = serve_stream(pm, StreamTable.from_batches(batches))
+    with config.transient_retry_mode(3):
+        with faults.flaky("serving.batch", times=2) as plan:
+            retried = serve_stream(pm, StreamTable.from_batches(batches))
+    assert plan.failures == 2
+    for a, b in zip(clean, retried):
+        np.testing.assert_array_equal(
+            np.asarray(a.column("norm")), np.asarray(b.column("norm"))
+        )
+    with config.transient_retry_mode(0):
+        with faults.flaky("serving.batch", times=1):
+            with pytest.raises(TransientFault):
+                serve_stream(pm, StreamTable.from_batches(batches))
+
+
+def test_health_snapshot_shape():
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=2)
+    list(server.serve(StreamTable.from_batches(_batches([4, 4]))))
+    h = server.health()
+    assert h.inFlight == 2 and h.windowDepth == 0
+    assert h.bucketsSeen == 1
+    assert h.emaBatchMs >= 0.0

@@ -486,6 +486,122 @@ class TestCoverageRules:
 
 
 # ---------------------------------------------------------------------------
+# unbounded-queue
+# ---------------------------------------------------------------------------
+
+class TestUnboundedQueue:
+    def test_true_positive_bare_deque_and_queue(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import queue
+                from collections import deque
+
+                pending = deque()
+                inbox = queue.Queue()
+                lifo = queue.LifoQueue(maxsize=0)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        kinds = sorted(f.data[0] for f in report.findings)
+        assert kinds == ["deque", "queue", "queue"]
+        assert all(f.rule == "unbounded-queue" for f in report.findings)
+
+    def test_true_positive_raw_thread_spawn(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import threading
+
+                def go(fn):
+                    t = threading.Thread(target=fn, daemon=True)
+                    t.start()
+                    return t
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        assert [f.data[0] for f in report.findings] == ["thread"]
+        assert "flow.pump" in report.findings[0].message
+
+    def test_true_positive_simplequeue_and_from_imports(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from queue import Queue, SimpleQueue
+                from threading import Thread
+
+                a = Queue()
+                b = SimpleQueue()  # cannot be bounded at all
+                c = Thread(target=print)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        kinds = sorted(f.data[0] for f in report.findings)
+        assert kinds == ["queue", "queue", "thread"]
+
+    def test_true_negative_bounded_structures(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/ok.py": """
+                import queue
+                import collections
+                from collections import deque
+
+                ring = deque(maxlen=128)
+                ring2 = collections.deque([], 16)
+                inbox = queue.Queue(maxsize=8)
+                inbox2 = queue.Queue(cap)  # dynamic bound: trusted
+                counts = collections.Counter()
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        assert report.findings == []
+
+    def test_true_negative_flow_and_prefetch_exempt(self, tmp_path):
+        src = """
+            import threading
+            from collections import deque
+
+            items = deque()
+            worker = threading.Thread(target=print)
+        """
+        report = _run(tmp_path, {
+            "flow.py": src,
+            "parallel/prefetch.py": src,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["unbounded-queue"])
+        assert report.findings == []
+
+    def test_suppression_with_reason_hides_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/ok.py": """
+                from collections import deque
+
+                # tpulint: disable=unbounded-queue -- drained past depth in the same call
+                q = deque()
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_unused_suppression_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/ok.py": """
+                from collections import deque
+
+                # tpulint: disable=unbounded-queue -- stale
+                q = deque(maxlen=4)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unbounded-queue"])
+        assert [f.rule for f in report.findings] == ["unused-suppression"]
+
+
+# ---------------------------------------------------------------------------
 # engine / suppression machinery
 # ---------------------------------------------------------------------------
 
