@@ -1,0 +1,720 @@
+"""Project-wide call graph + interprocedural taint summaries.
+
+tpulint v1 tracked host-sync taint **per function**: a device→host pull
+laundered through one helper escaped the pass entirely, because an
+unknown call cleared taint (docs/static_analysis.md admitted as much).
+This module closes that hole without giving up the under-approximation
+discipline — *known* calls are resolved and summarized, *unknown* calls
+still launder:
+
+- **Call resolution** (`CallGraph.resolve`): module-qualified, built on
+  the same alias machinery as `rules/_jitindex.py`. Resolves module-level
+  functions by local name, one-hop ``from``-imports (``from ..ops import
+  stats`` → ``stats.fn``), and ``self.``/``cls.`` method calls within the
+  defining class. Anything else stays unknown.
+- **Summaries** (`CallGraph.summary`): one bounded-depth, memoized,
+  cycle-safe :class:`Summary` per function, stating how the function
+  behaves *as a function of its parameters*:
+
+  - ``returns_device`` — its return value is device-tainted regardless
+    of arguments (it calls into jnp/lax/jitted kernels and returns that);
+  - ``returns_params`` — parameter indices whose taint flows through to
+    the return value (the function *launders* rather than syncs);
+  - ``param_syncs`` — parameters that reach a blocking host sync inside
+    the function (``np.asarray``/casts), each with the sink's file:line
+    and the qualname chain down to it;
+  - ``param_donates`` — parameters passed into a donated position of a
+    donating jit kernel (so a *wrapper* around a donating kernel donates
+    its own argument's buffer, transitively);
+  - ``param_closes`` — parameters (channels) the function closes or
+    cancels (the channel-protocol rule's escape analysis).
+
+- **The taint walker** (`TaintWalker`): the linear per-function pass,
+  generalized from v1's boolean taint to *source sets* — a value's
+  sources are any of ``DEVICE`` and parameter indices — so one walk per
+  function yields both the local findings (device-sourced sinks) and the
+  summary (param-sourced sinks, return flow). Recursion is cut by an
+  in-progress sentinel (a cycle contributes the empty summary —
+  conservative, never wrong), and lifted chains are capped at
+  ``MAX_CHAIN`` hops.
+
+`host-sync-leak` and `donation-after-use` consult these summaries so the
+``np.asarray`` buried two helpers deep is flagged at the top-level call
+site with the full call chain in the finding; `channel-protocol` uses
+``param_closes`` and `lock-order` reuses `resolve` for its own
+acquisition summaries.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .source import SourceModule, dotted_name
+
+#: source token for "a device array" (parameter sources are int indices)
+DEVICE = "device"
+
+#: rule id whose suppressions stop a sink from entering callee summaries:
+#: a host-sync-leak disable comment on the sink line means the sync is a
+#: documented deliberate one, so callers inherit no finding (the annotated
+#: helper itself still shows in the --show-suppressed census)
+HOST_SYNC_RULE = "host-sync-leak"
+
+#: lifted call chains stop growing past this many hops (bounded depth)
+MAX_CHAIN = 8
+
+# attribute reads that return host metadata, not device payloads
+META_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "sharding", "itemsize"}
+
+# call targets that return HOST values (clear taint)
+HOST_SINKS = {
+    "packed_device_get",
+    "device_get",  # jax.device_get
+    "float",
+    "int",
+    "bool",
+    "len",
+    "str",
+    "repr",
+}
+
+
+# ---------------------------------------------------------------------------
+# declarations and summaries
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FunctionDecl:
+    """One statically-declared function: module-level ``def`` or a method
+    (qualname ``Class.method``)."""
+
+    path: str  # repo-relative module path
+    qualname: str
+    params: Tuple[str, ...]  # positional parameter names, in order
+    is_method: bool
+    node: ast.AST = field(compare=False, hash=False, repr=False)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qualname)
+
+
+@dataclass(frozen=True)
+class SyncSite:
+    """One host-sync sink a parameter reaches, with the call chain from
+    the summarized function down to it (``funcs`` qualnames, outermost
+    first; empty = the sink is in the summarized function itself)."""
+
+    kind: str  # "np-pull" | "cast"
+    detail: str  # asarray / float / ...
+    sink_path: str
+    sink_line: int
+    funcs: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class DonationSite:
+    """A parameter's buffer is donated (directly or through wrappers) to
+    ``kernel`` at ``sink_path:sink_line``."""
+
+    kernel: str
+    sink_path: str
+    sink_line: int
+    funcs: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Summary:
+    """What a function does with its parameters — the unit the
+    interprocedural rules consult instead of laundering at the call."""
+
+    returns_device: bool = False
+    returns_params: FrozenSet[int] = frozenset()
+    param_syncs: Tuple[Tuple[int, Tuple[SyncSite, ...]], ...] = ()
+    param_donates: Tuple[Tuple[int, Tuple[DonationSite, ...]], ...] = ()
+    param_closes: FrozenSet[int] = frozenset()
+
+    def syncs_for(self, index: int) -> Tuple[SyncSite, ...]:
+        for i, sites in self.param_syncs:
+            if i == index:
+                return sites
+        return ()
+
+    def donates_for(self, index: int) -> Tuple[DonationSite, ...]:
+        for i, sites in self.param_donates:
+            if i == index:
+                return sites
+        return ()
+
+    @property
+    def donated_positions(self) -> Tuple[int, ...]:
+        return tuple(sorted(i for i, _ in self.param_donates))
+
+
+EMPTY_SUMMARY = Summary()
+
+
+@dataclass
+class SyncEvent:
+    """One host-sync sink observed while walking a function, with the
+    source set of the value it syncs. ``DEVICE`` sources become rule
+    findings; parameter sources become summary entries."""
+
+    line: int
+    kind: str
+    detail: str
+    sources: FrozenSet
+    sink_path: str
+    sink_line: int
+    funcs: Tuple[str, ...] = ()  # lifted call chain (empty = direct sink)
+
+
+@dataclass
+class FunctionAnalysis:
+    decl: Optional[FunctionDecl]
+    events: List[SyncEvent]
+    summary: Summary
+
+
+# ---------------------------------------------------------------------------
+# the call graph
+# ---------------------------------------------------------------------------
+
+class CallGraph:
+    """Declarations, resolution, and memoized per-function analyses over
+    one :class:`~.engine.Project`."""
+
+    def __init__(self, project):
+        from .rules import _jitindex  # deferred: rules/ imports this module
+
+        self.project = project
+        self.jitindex = _jitindex.jit_index(project)
+        # path -> {qualname: decl}
+        self.by_module: Dict[str, Dict[str, FunctionDecl]] = {}
+        # dotted module name -> path
+        self.module_paths: Dict[str, str] = {}
+        self._analyses: Dict[Tuple[str, str], FunctionAnalysis] = {}
+        self._in_progress: Set[Tuple[str, str]] = set()
+        for module in project.modules:
+            self._declare(module)
+
+    # -- declarations --------------------------------------------------------
+    def _declare(self, module: SourceModule) -> None:
+        table: Dict[str, FunctionDecl] = {}
+        if module.tree is not None:
+            for node in module.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    table[node.name] = self._decl(module, node, node.name, False)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            table[f"{node.name}.{item.name}"] = self._decl(
+                                module, item, f"{node.name}.{item.name}", True
+                            )
+        self.by_module[module.path] = table
+        if module.module_name:
+            self.module_paths[module.module_name] = module.path
+
+    @staticmethod
+    def _decl(module, node, qualname, is_method) -> FunctionDecl:
+        params = tuple(
+            a.arg for a in list(node.args.posonlyargs) + list(node.args.args)
+        )
+        return FunctionDecl(
+            path=module.path,
+            qualname=qualname,
+            params=params,
+            is_method=is_method,
+            node=node,
+        )
+
+    def decls_in(self, path: str) -> Dict[str, FunctionDecl]:
+        return self.by_module.get(path, {})
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(
+        self,
+        module: SourceModule,
+        func: ast.AST,
+        current_class: Optional[str] = None,
+    ) -> Optional[Tuple[FunctionDecl, bool]]:
+        """Resolve a call target to its declaration. Returns ``(decl,
+        skip_self)`` — ``skip_self`` means the call site's positional args
+        start at the decl's second parameter (a bound-method call) — or
+        None for anything not statically resolvable."""
+        info = self.jitindex.get(module.path)
+        table = self.by_module.get(module.path, {})
+        if isinstance(func, ast.Name):
+            decl = table.get(func.id)
+            if decl is not None and not decl.is_method:
+                return decl, False
+            if info is not None and func.id in info.imports:
+                target_module, original = info.imports[func.id]
+                target_path = self.module_paths.get(target_module)
+                if target_path is not None:
+                    decl = self.by_module.get(target_path, {}).get(original)
+                    if decl is not None and not decl.is_method:
+                        return decl, False
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            root = func.value.id
+            if root in ("self", "cls") and current_class:
+                decl = table.get(f"{current_class}.{func.attr}")
+                if decl is not None:
+                    return decl, True
+                return None
+            # module-alias attribute: `from ..ops import stats; stats.fn(...)`
+            if info is not None and root in info.imports:
+                target_module, original = info.imports[root]
+                target_path = self.module_paths.get(f"{target_module}.{original}")
+                if target_path is not None:
+                    decl = self.by_module.get(target_path, {}).get(func.attr)
+                    if decl is not None and not decl.is_method:
+                        return decl, False
+        return None
+
+    # -- analysis ------------------------------------------------------------
+    def analyze(self, decl: FunctionDecl) -> FunctionAnalysis:
+        """Walk ``decl`` once, yielding its local sync events AND its
+        summary. Memoized; recursion (a call cycle) sees the empty
+        summary — conservative and terminating."""
+        cached = self._analyses.get(decl.key)
+        if cached is not None:
+            return cached
+        if decl.key in self._in_progress:
+            return FunctionAnalysis(decl, [], EMPTY_SUMMARY)
+        self._in_progress.add(decl.key)
+        try:
+            module = self.project.module_at(decl.path)
+            info = self.jitindex.get(decl.path)
+            params = list(decl.params)
+            current_class = None
+            if decl.is_method:
+                current_class = decl.qualname.split(".")[0]
+                if params and params[0] in ("self", "cls"):
+                    params = params[1:]
+            walker = TaintWalker(
+                graph=self,
+                module=module,
+                info=info,
+                params={name: i for i, name in enumerate(params)},
+                current_class=current_class,
+            )
+            walker.run_block(decl.node.body)
+            analysis = FunctionAnalysis(
+                decl=decl, events=walker.events, summary=walker.build_summary()
+            )
+        finally:
+            self._in_progress.discard(decl.key)
+        self._analyses[decl.key] = analysis
+        return analysis
+
+    def summary(self, decl: FunctionDecl) -> Summary:
+        return self.analyze(decl).summary
+
+    def donating_functions(
+        self, module: SourceModule
+    ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+        """Local names in ``module`` that resolve to functions whose
+        summaries donate parameters: name -> (positions, chain label).
+        The donation-after-use rule merges these with the direct
+        jit-kernel donation table."""
+        out: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        info = self.jitindex.get(module.path)
+        candidates: Dict[str, FunctionDecl] = {}
+        for qualname, decl in self.by_module.get(module.path, {}).items():
+            if not decl.is_method:
+                candidates[qualname] = decl
+        if info is not None:
+            for bound, (target_module, original) in info.imports.items():
+                target_path = self.module_paths.get(target_module)
+                if target_path is None:
+                    continue
+                decl = self.by_module.get(target_path, {}).get(original)
+                if decl is not None and not decl.is_method:
+                    candidates.setdefault(bound, decl)
+        for name, decl in candidates.items():
+            summary = self.summary(decl)
+            positions = summary.donated_positions
+            if not positions:
+                continue
+            site = summary.donates_for(positions[0])[0]
+            label = " -> ".join((decl.qualname,) + site.funcs + (site.kernel,))
+            out[name] = (positions, label)
+        return out
+
+
+def get(project) -> CallGraph:
+    """The project's memoized call graph (shared across rules)."""
+    return project.index("callgraph", CallGraph)
+
+
+# ---------------------------------------------------------------------------
+# the source-set taint walker
+# ---------------------------------------------------------------------------
+
+class TaintWalker:
+    """Linear taint pass over one function body (or the module level),
+    tracking *source sets* per name: ``DEVICE`` and/or parameter indices.
+
+    With ``graph=None`` the walker degrades to tpulint v1's per-function
+    behavior — every call is unknown and launders — which the tier-1
+    superset test uses as the recall baseline.
+    """
+
+    def __init__(
+        self,
+        graph: Optional[CallGraph],
+        module: SourceModule,
+        info,
+        params: Optional[Dict[str, int]] = None,
+        current_class: Optional[str] = None,
+    ):
+        self.graph = graph
+        self.module = module
+        self.info = info
+        self.current_class = current_class
+        self.env: Dict[str, FrozenSet] = {
+            name: frozenset({index}) for name, index in (params or {}).items()
+        }
+        self.events: List[SyncEvent] = []
+        self.returns: Set = set()
+        self._param_syncs: Dict[int, List[SyncSite]] = {}
+        self._param_donates: Dict[int, List[DonationSite]] = {}
+        self._param_closes: Set[int] = set()
+
+    # -- summary assembly ----------------------------------------------------
+    def build_summary(self) -> Summary:
+        # a suppression on the sink line documents the sync as deliberate:
+        # the site stays out of the summary, so callers inherit no finding
+        # (lifted sites were filtered when the deeper summary was built)
+        suppressed_sinks = set(self.module.suppressions_for(HOST_SYNC_RULE))
+        # parameter-sourced sink events fold into the summary
+        for event in self.events:
+            for source in event.sources:
+                if source == DEVICE:
+                    continue
+                if event.kind in ("np-pull", "cast"):
+                    if not event.funcs and event.sink_line in suppressed_sinks:
+                        continue
+                    self._param_syncs.setdefault(source, []).append(
+                        SyncSite(
+                            kind=event.kind,
+                            detail=event.detail,
+                            sink_path=event.sink_path,
+                            sink_line=event.sink_line,
+                            funcs=event.funcs,
+                        )
+                    )
+        return Summary(
+            returns_device=DEVICE in self.returns,
+            returns_params=frozenset(s for s in self.returns if s != DEVICE),
+            param_syncs=tuple(
+                (i, tuple(sites)) for i, sites in sorted(self._param_syncs.items())
+            ),
+            param_donates=tuple(
+                (i, tuple(sites)) for i, sites in sorted(self._param_donates.items())
+            ),
+            param_closes=frozenset(self._param_closes),
+        )
+
+    # -- source evaluation ---------------------------------------------------
+    def sources(self, node: ast.AST) -> FrozenSet:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, frozenset())
+        if isinstance(node, ast.Call):
+            return self.call_sources(node)
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return frozenset()
+            return self.sources(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.sources(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.sources(node.left) | self.sources(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.sources(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.sources(node.body) | self.sources(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: FrozenSet = frozenset()
+            for elt in node.elts:
+                out |= self.sources(elt)
+            return out
+        if isinstance(node, ast.Starred):
+            return self.sources(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.sources(node.value)
+        return frozenset()
+
+    def _arg_sources(self, call: ast.Call, index: int, decl, skip_self) -> FrozenSet:
+        """Sources of the value bound to the callee's parameter ``index``
+        (indices count AFTER self for method calls)."""
+        args = call.args
+        if index < len(args):
+            arg = args[index]
+            if isinstance(arg, ast.Starred):
+                return frozenset()
+            return self.sources(arg)
+        params = list(decl.params)
+        if skip_self and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        if index < len(params):
+            name = params[index]
+            for kw in call.keywords:
+                if kw.arg == name:
+                    return self.sources(kw.value)
+        return frozenset()
+
+    def call_sources(self, call: ast.Call) -> FrozenSet:
+        func = call.func
+        name = dotted_name(func)
+        if name is not None:
+            base = name.split(".")[-1]
+            if base in HOST_SINKS:
+                return frozenset()
+            root = name.split(".")[0]
+            if root in self.info.np_aliases:
+                return frozenset()  # numpy returns host arrays
+            if self.info.device_namespace_call(func):
+                return frozenset({DEVICE})
+            if name in self.info.kernels:
+                return frozenset({DEVICE})
+            if base == "device_constants":
+                return frozenset({DEVICE})
+        # keyed factory double-call: jit_find_closest(measure)(X, C)
+        if isinstance(func, ast.Call):
+            inner = dotted_name(func.func)
+            if inner is not None and (
+                inner in self.info.factories or inner in self.info.keyed_jit_names
+            ):
+                return frozenset({DEVICE})
+            if self.info.is_jit_callable(func.func):
+                return frozenset({DEVICE})  # jax.jit(f)(args) / lazy_jit(f)(args)
+        # known callee: taint flows per the summary instead of laundering
+        resolved = self._resolve(call)
+        if resolved is not None:
+            decl, skip_self = resolved
+            summary = self.graph.summary(decl)
+            out: Set = set()
+            if summary.returns_device:
+                out.add(DEVICE)
+            for index in summary.returns_params:
+                out |= self._arg_sources(call, index, decl, skip_self)
+            return frozenset(out)
+        # x.method() where x carries sources: device-array methods stay on
+        # device; a param's method result keeps the param's sources
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr not in META_ATTRS
+            and self.sources(func.value)
+        ):
+            return self.sources(func.value)
+        return frozenset()
+
+    def _resolve(self, call: ast.Call):
+        if self.graph is None:
+            return None
+        func = call.func
+        name = dotted_name(func)
+        # jitted kernels/factories are device producers, not summarizable
+        # host code (their bodies run at trace time)
+        if name is not None and (
+            name in self.info.kernels or name in self.info.factories
+        ):
+            return None
+        return self.graph.resolve(self.module, func, self.current_class)
+
+    # -- statement handling --------------------------------------------------
+    def assign(self, target: ast.AST, value_sources: FrozenSet) -> None:
+        if isinstance(target, ast.Name):
+            if value_sources:
+                self.env[target.id] = value_sources
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign(
+                    elt.value if isinstance(elt, ast.Starred) else elt,
+                    value_sources,
+                )
+
+    def run_block(self, body) -> None:
+        for stmt in body:
+            self.run_statement(stmt)
+
+    def run_statement(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope, analyzed on its own
+        self.scan_expressions(stmt)
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns |= self.sources(stmt.value)
+        elif isinstance(stmt, ast.Assign):
+            value_sources = self.sources(stmt.value)
+            for target in stmt.targets:
+                self.assign(target, value_sources)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, self.sources(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                merged = self.sources(stmt.value) | self.sources(stmt.target)
+                if merged:
+                    self.env[stmt.target.id] = merged
+        elif isinstance(stmt, ast.For):
+            self.assign(stmt.target, self.sources(stmt.iter))
+            self.run_block(stmt.body)
+            self.run_block(stmt.orelse)
+            return
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, self.sources(item.context_expr))
+            self.run_block(stmt.body)
+            return
+        for block in (
+            getattr(stmt, "body", None),
+            getattr(stmt, "orelse", None),
+            getattr(stmt, "finalbody", None),
+        ):
+            if block and isinstance(block, list):
+                self.run_block(block)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self.run_block(handler.body)
+
+    # -- sink detection ------------------------------------------------------
+    def scan_expressions(self, stmt: ast.stmt) -> None:
+        from .rules import _astwalk
+
+        for header in _astwalk.header_nodes(stmt):
+            for node in ast.walk(header):
+                if isinstance(node, ast.Call):
+                    self.check_call(node)
+
+    def _emit(
+        self,
+        line: int,
+        kind: str,
+        detail: str,
+        sources: FrozenSet,
+        sink_path: Optional[str] = None,
+        sink_line: Optional[int] = None,
+        funcs: Tuple[str, ...] = (),
+    ) -> None:
+        self.events.append(
+            SyncEvent(
+                line=line,
+                kind=kind,
+                detail=detail,
+                sources=sources,
+                sink_path=sink_path if sink_path is not None else self.module.path,
+                sink_line=sink_line if sink_line is not None else line,
+                funcs=funcs,
+            )
+        )
+
+    def check_call(self, call: ast.Call) -> None:
+        func = call.func
+        name = dotted_name(func)
+
+        # block_until_ready: barrier outside the accounted funnels —
+        # unconditionally a local finding, never lifted (the helper's own
+        # module already reports it)
+        if (isinstance(func, ast.Attribute) and func.attr == "block_until_ready") or (
+            name is not None and name.split(".")[-1] == "block_until_ready"
+        ):
+            self._emit(call.lineno, "barrier", "block_until_ready", frozenset({DEVICE}))
+            return
+
+        # .item(): always a scalar pull, always local
+        if isinstance(func, ast.Attribute) and func.attr == "item" and not call.args:
+            self._emit(call.lineno, "item", "item", frozenset({DEVICE}))
+            return
+
+        # channel close/cancel on a parameter (channel-protocol summary)
+        if isinstance(func, ast.Attribute) and func.attr in ("close", "cancel"):
+            for source in self.sources(func.value):
+                if source != DEVICE:
+                    self._param_closes.add(source)
+
+        if name is not None and call.args:
+            root, _, rest = name.partition(".")
+            arg = call.args[0]
+            # np.asarray / np.array on a sourced value
+            if root in self.info.np_aliases and rest in (
+                "asarray",
+                "array",
+                "ascontiguousarray",
+            ):
+                arg_sources = self.sources(arg)
+                if arg_sources:
+                    self._emit(call.lineno, "np-pull", rest, arg_sources)
+            # float()/int()/bool() casts on a sourced value
+            elif name in ("float", "int", "bool"):
+                arg_sources = self.sources(arg)
+                if arg_sources:
+                    self._emit(call.lineno, "cast", name, arg_sources)
+
+        # direct donation: donating kernel called with a param-sourced name
+        if name is not None and name in self.info.kernels:
+            positions = self.info.kernels[name]
+            if positions and not any(
+                isinstance(a, ast.Starred) for a in call.args
+            ):
+                for pos in positions:
+                    if pos < len(call.args) and isinstance(call.args[pos], ast.Name):
+                        for source in self.env.get(call.args[pos].id, frozenset()):
+                            if source != DEVICE:
+                                self._param_donates.setdefault(source, []).append(
+                                    DonationSite(
+                                        kernel=name,
+                                        sink_path=self.module.path,
+                                        sink_line=call.lineno,
+                                    )
+                                )
+
+        # interprocedural lifting: consult the callee's summary
+        resolved = self._resolve(call)
+        if resolved is None:
+            return
+        decl, skip_self = resolved
+        summary = self.graph.summary(decl)
+        for index, sites in summary.param_syncs:
+            arg_sources = self._arg_sources(call, index, decl, skip_self)
+            if not arg_sources:
+                continue
+            for site in sites:
+                if len(site.funcs) >= MAX_CHAIN:
+                    continue  # bounded-depth: stop lifting runaway chains
+                self._emit(
+                    call.lineno,
+                    site.kind,
+                    site.detail,
+                    arg_sources,
+                    sink_path=site.sink_path,
+                    sink_line=site.sink_line,
+                    funcs=(decl.qualname,) + site.funcs,
+                )
+        for index, sites in summary.param_donates:
+            arg_sources = self._arg_sources(call, index, decl, skip_self)
+            for source in arg_sources:
+                if source == DEVICE:
+                    continue
+                for site in sites:
+                    if len(site.funcs) >= MAX_CHAIN:
+                        continue
+                    self._param_donates.setdefault(source, []).append(
+                        DonationSite(
+                            kernel=site.kernel,
+                            sink_path=site.sink_path,
+                            sink_line=site.sink_line,
+                            funcs=(decl.qualname,) + site.funcs,
+                        )
+                    )
+        for index in summary.param_closes:
+            arg_sources = self._arg_sources(call, index, decl, skip_self)
+            for source in arg_sources:
+                if source != DEVICE:
+                    self._param_closes.add(source)

@@ -3,10 +3,12 @@ the engine registry (flink_ml_tpu.analysis.engine)."""
 
 from . import (  # noqa: F401
     accounting,
+    channelprotocol,
     coverage,
     donation,
     flowcontrol,
     hostsync,
+    lockorder,
     retrace,
     shardingtags,
 )

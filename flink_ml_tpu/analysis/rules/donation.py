@@ -19,6 +19,12 @@ position is dead after the call statement; any later load of that name
 before a rebind is a finding. Donated names rebound by the call statement
 itself (the ping-pong carry idiom) are fine. Calls with ``*args`` before
 a donated position are skipped — positions are unknowable statically.
+
+Since v2 the rule is interprocedural: the project call graph
+(``analysis/callgraph.py``) summarizes which functions pass their own
+parameters into donated positions — so a *wrapper* around a donating
+kernel donates its caller's buffer too, and reading after the wrapper
+call is flagged with the wrapper→kernel chain in the finding.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from .. import callgraph
 from ..engine import Finding, Rule, register
 from ..source import SourceModule
 from . import _jitindex
@@ -34,18 +41,21 @@ from ._astwalk import statements_in_order
 
 
 def _donating_alias(
-    kernels: Dict[str, Tuple[int, ...]], value: ast.AST
-) -> Optional[Tuple[int, ...]]:
-    """Donated positions if ``value`` may evaluate to a donating kernel
-    (a bare name, or either arm of the donation-gating IfExp idiom)."""
+    kernels: Dict[str, Tuple[Tuple[int, ...], Optional[str]]], value: ast.AST
+) -> Optional[Tuple[Tuple[int, ...], Optional[str]]]:
+    """The ``(positions, chain label)`` entry if ``value`` may evaluate to
+    a donating kernel (a bare name, or either arm of the donation-gating
+    IfExp idiom)."""
     if isinstance(value, ast.Name):
-        positions = kernels.get(value.id, ())
-        return positions or None
+        entry = kernels.get(value.id)
+        if entry and entry[0]:
+            return entry
+        return None
     if isinstance(value, ast.IfExp):
         for arm in (value.body, value.orelse):
-            positions = _donating_alias(kernels, arm)
-            if positions:
-                return positions
+            entry = _donating_alias(kernels, arm)
+            if entry:
+                return entry
     return None
 
 
@@ -86,13 +96,25 @@ class DonationAfterUseRule(Rule):
     )
     scope = ("flink_ml_tpu",)
 
+    #: consult callee summaries for wrapper-level donation (False = the
+    #: tpulint v1 per-function recall baseline)
+    interprocedural = True
+
     def check_module(
         self, project, module: SourceModule
     ) -> Iterable[Finding]:
         if module.tree is None:
             return ()
         info = _jitindex.jit_index(project)[module.path]
-        donating = {n: p for n, p in info.kernels.items() if p}
+        donating: Dict[str, Tuple[Tuple[int, ...], Optional[str]]] = {
+            n: (p, None) for n, p in info.kernels.items() if p
+        }
+        if self.interprocedural:
+            graph = callgraph.get(project)
+            for name, (positions, label) in graph.donating_functions(
+                module
+            ).items():
+                donating.setdefault(name, (positions, label))
         if not donating:
             return ()
         findings: List[Finding] = []
@@ -141,9 +163,9 @@ class DonationAfterUseRule(Rule):
             if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
                 target = stmt.targets[0]
                 if isinstance(target, ast.Name):
-                    positions = _donating_alias(donating, stmt.value)
-                    if positions:
-                        aliases[target.id] = positions
+                    entry = _donating_alias(donating, stmt.value)
+                    if entry:
+                        aliases[target.id] = entry
                     elif target.id in aliases:
                         del aliases[target.id]
             # donation: any call to a donating kernel (or alias) in stmt
@@ -154,14 +176,16 @@ class DonationAfterUseRule(Rule):
                 if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
             ]
             for sub in calls:
-                positions = donating.get(sub.func.id) or aliases.get(sub.func.id)
-                if not positions:
+                entry = donating.get(sub.func.id) or aliases.get(sub.func.id)
+                if not entry:
                     continue
+                positions, label = entry
                 if any(isinstance(a, ast.Starred) for a in sub.args):
                     continue  # positions unknowable statically
+                kernel = sub.func.id if label is None else f"{sub.func.id} ({label})"
                 for pos in positions:
                     if pos < len(sub.args) and isinstance(sub.args[pos], ast.Name):
-                        poisoned[sub.args[pos].id] = (sub.func.id, sub.lineno)
+                        poisoned[sub.args[pos].id] = (kernel, sub.lineno)
             # rebinds clear the poison (after the call in the same stmt)
             for name in _stored_names(stmt):
                 poisoned.pop(name, None)

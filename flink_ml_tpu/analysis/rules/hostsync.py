@@ -7,263 +7,58 @@ line did it. The sanctioned funnel is ``utils/packing.packed_device_get``
 (one packed transfer, ``host_sync.*``/``readback.*`` accounted); this
 rule flags the ways a sync leaks around it:
 
-- ``np.asarray(x)`` / ``np.array(x)`` where ``x`` dataflow-locally traces
-  back to a device array (a jnp/lax call, a jitted kernel's result,
-  memoized ``device_constants()``) — numpy silently issues a blocking
+- ``np.asarray(x)`` / ``np.array(x)`` where ``x`` traces back to a
+  device array (a jnp/lax call, a jitted kernel's result, memoized
+  ``device_constants()``) — numpy silently issues a blocking
   device→host copy;
 - ``float(x)`` / ``int(x)`` / ``bool(x)`` on such values — same sync,
   hidden in a cast;
 - ``.item()`` — the idiomatic scalar pull, always a blocking sync;
 - ``block_until_ready`` — a deliberate barrier, which is exactly why it
   must be either inside an accounted funnel or annotated with a
-  suppression carrying its reason.
+  suppression carrying its reason;
+- **a device value passed to a helper that syncs it** — since v2 the
+  rule consults the project call graph (``analysis/callgraph.py``): a
+  *known* call resolves to the callee's bounded-depth summary, so an
+  ``np.asarray`` buried two helpers deep is flagged at the top-level
+  call site, with the full call chain and the sink's file:line in the
+  finding.
 
-Taint is tracked per function, linearly (assignments through jnp/lax
-namespaces, known jit kernels and keyed-kernel factories, arithmetic on
-tainted values, tuple unpacking); host-producing calls
-(``packed_device_get``, ``jax.device_get``, ``np.asarray``) clear it.
-Shape/dtype/ndim attribute reads are host metadata, not taint. The rule
-under-approximates by design: unknown calls launder taint, so every
-finding is worth reading — fix it through the funnel or suppress it with
-the reason the sync is deliberate. The resulting suppression set IS the
-library's audited census of host sync points.
+Taint is tracked per function as source sets (device and/or parameter
+origins); the interprocedural summaries fold parameter-sourced sinks
+into the callers. *Unknown* calls still launder taint — the rule
+under-approximates by design, so every finding is worth reading. The
+resulting suppression set IS the library's audited census of host sync
+points.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Iterable, List
 
+from .. import callgraph
 from ..engine import Finding, Rule, register
-from ..source import SourceModule, dotted_name
-from . import _astwalk, _jitindex
+from ..source import SourceModule
+from . import _jitindex
 
-# attribute reads that return host metadata, not device payloads
-_META_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "sharding", "itemsize"}
+# backwards-compatible aliases (v1 exported these from here)
+_META_ATTRS = callgraph.META_ATTRS
+_HOST_SINKS = callgraph.HOST_SINKS
 
-# call targets that return HOST values (clear taint)
-_HOST_SINKS = {
-    "packed_device_get",
-    "device_get",  # jax.device_get
-    "float",
-    "int",
-    "bool",
-    "len",
-    "str",
-    "repr",
+_DIRECT_MESSAGES = {
+    "barrier": (
+        "block_until_ready is a blocking device sync outside the "
+        "accounted funnels — route the readback through "
+        "packed_device_get, or suppress with the reason this "
+        "barrier is deliberate"
+    ),
+    "item": (
+        ".item() issues a blocking device->host scalar pull — "
+        "batch it through packed_device_get (or keep the value "
+        "on device)"
+    ),
 }
-
-
-class _FunctionTaint(ast.NodeVisitor):
-    """Linear taint pass over one function body."""
-
-    def __init__(self, rule, module, info, findings):
-        self.rule = rule
-        self.module = module
-        self.info = info
-        self.findings = findings
-        self.tainted: Set[str] = set()
-
-    # -- taint evaluation ----------------------------------------------------
-
-    def is_tainted(self, node: ast.AST) -> bool:
-        if isinstance(node, ast.Name):
-            return node.id in self.tainted
-        if isinstance(node, ast.Call):
-            return self.call_returns_device(node)
-        if isinstance(node, ast.Attribute):
-            if node.attr in _META_ATTRS:
-                return False
-            return self.is_tainted(node.value)
-        if isinstance(node, ast.Subscript):
-            return self.is_tainted(node.value)
-        if isinstance(node, ast.BinOp):
-            return self.is_tainted(node.left) or self.is_tainted(node.right)
-        if isinstance(node, ast.UnaryOp):
-            return self.is_tainted(node.operand)
-        if isinstance(node, ast.IfExp):
-            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
-        if isinstance(node, (ast.Tuple, ast.List)):
-            return any(self.is_tainted(e) for e in node.elts)
-        if isinstance(node, ast.Starred):
-            return self.is_tainted(node.value)
-        if isinstance(node, ast.NamedExpr):
-            return self.is_tainted(node.value)
-        return False
-
-    def call_returns_device(self, call: ast.Call) -> bool:
-        func = call.func
-        name = dotted_name(func)
-        if name is not None:
-            base = name.split(".")[-1]
-            if base in _HOST_SINKS:
-                return False
-            root = name.split(".")[0]
-            if root in self.info.np_aliases:
-                return False  # numpy returns host arrays
-            if self.info.device_namespace_call(func):
-                return True
-            if name in self.info.kernels:
-                return True
-            # method producing the memoized device-constant dict
-            if base == "device_constants":
-                return True
-        # keyed factory double-call: jit_find_closest(measure)(X, C)
-        if isinstance(func, ast.Call):
-            inner = dotted_name(func.func)
-            if inner is not None and (
-                inner in self.info.factories
-                or inner in self.info.keyed_jit_names
-            ):
-                return True
-            if self.info.is_jit_callable(func.func):
-                return True  # jax.jit(f)(args) / lazy_jit(f)(args)
-        # x.method() where x is tainted: device-array methods (astype,
-        # reshape, sum, ...) stay on device
-        if (
-            isinstance(func, ast.Attribute)
-            and func.attr not in _META_ATTRS
-            and self.is_tainted(func.value)
-        ):
-            return True
-        return False
-
-    # -- statement handling --------------------------------------------------
-
-    def assign(self, target: ast.AST, value_tainted: bool) -> None:
-        if isinstance(target, ast.Name):
-            if value_tainted:
-                self.tainted.add(target.id)
-            else:
-                self.tainted.discard(target.id)
-        elif isinstance(target, (ast.Tuple, ast.List)):
-            for elt in target.elts:
-                self.assign(
-                    elt.value if isinstance(elt, ast.Starred) else elt,
-                    value_tainted,
-                )
-
-    def run_block(self, body) -> None:
-        for stmt in body:
-            self.run_statement(stmt)
-
-    def run_statement(self, stmt: ast.stmt) -> None:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            return  # separate scope, analyzed on its own
-        self.scan_expressions(stmt)
-        if isinstance(stmt, ast.Assign):
-            tainted = self.is_tainted(stmt.value)
-            for target in stmt.targets:
-                self.assign(target, tainted)
-        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-            self.assign(stmt.target, self.is_tainted(stmt.value))
-        elif isinstance(stmt, ast.AugAssign):
-            if isinstance(stmt.target, ast.Name):
-                if self.is_tainted(stmt.value) or self.is_tainted(stmt.target):
-                    self.tainted.add(stmt.target.id)
-        elif isinstance(stmt, ast.For):
-            self.assign(stmt.target, self.is_tainted(stmt.iter))
-            self.run_block(stmt.body)
-            self.run_block(stmt.orelse)
-            return
-        elif isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
-            for item in stmt.items:
-                if item.optional_vars is not None:
-                    self.assign(
-                        item.optional_vars, self.is_tainted(item.context_expr)
-                    )
-            self.run_block(stmt.body)
-            return
-        for block in (
-            getattr(stmt, "body", None),
-            getattr(stmt, "orelse", None),
-            getattr(stmt, "finalbody", None),
-        ):
-            if block and isinstance(block, list):
-                self.run_block(block)
-        for handler in getattr(stmt, "handlers", []) or []:
-            self.run_block(handler.body)
-
-    # -- finding generation --------------------------------------------------
-
-    def scan_expressions(self, stmt: ast.stmt) -> None:
-        # only the statement's own expressions: nested blocks are walked as
-        # their own statements by run_block, AFTER the taint state caught up
-        for header in _astwalk.header_nodes(stmt):
-            for node in ast.walk(header):
-                if isinstance(node, ast.Call):
-                    self.check_call(node)
-
-    def check_call(self, call: ast.Call) -> None:
-        func = call.func
-        name = dotted_name(func)
-
-        # block_until_ready: barrier outside the accounted funnels
-        if (isinstance(func, ast.Attribute) and func.attr == "block_until_ready") or (
-            name is not None and name.split(".")[-1] == "block_until_ready"
-        ):
-            self.emit(
-                call.lineno,
-                "block_until_ready is a blocking device sync outside the "
-                "accounted funnels — route the readback through "
-                "packed_device_get, or suppress with the reason this "
-                "barrier is deliberate",
-                ("block_until_ready",),
-            )
-            return
-
-        # .item(): always a scalar pull
-        if isinstance(func, ast.Attribute) and func.attr == "item" and not call.args:
-            self.emit(
-                call.lineno,
-                ".item() issues a blocking device->host scalar pull — "
-                "batch it through packed_device_get (or keep the value "
-                "on device)",
-                ("item",),
-            )
-            return
-
-        if name is None or not call.args:
-            return
-        root, _, rest = name.partition(".")
-        arg = call.args[0]
-
-        # np.asarray / np.array on a device value
-        if (
-            root in self.info.np_aliases
-            and rest in ("asarray", "array", "ascontiguousarray")
-            and self.is_tainted(arg)
-        ):
-            self.emit(
-                call.lineno,
-                f"np.{rest} on a device value is an implicit device->host "
-                "pull — route it through packed_device_get (accounted, "
-                "packed) or keep the computation on the host branch",
-                ("np-pull", rest),
-            )
-            return
-
-        # float()/int()/bool() casts on a device value
-        if name in ("float", "int", "bool") and self.is_tainted(arg):
-            self.emit(
-                call.lineno,
-                f"{name}() on a device value is a hidden blocking sync — "
-                "read it back through packed_device_get with the fit's "
-                "packed result instead",
-                ("cast", name),
-            )
-
-
-    def emit(self, line: int, message: str, data: Tuple) -> None:
-        self.findings.append(
-            Finding(
-                path=self.module.path,
-                line=line,
-                rule=self.rule.id,
-                message=message,
-                data=data,
-            )
-        )
 
 
 @register
@@ -275,12 +70,17 @@ class HostSyncLeakRule(Rule):
         "pull stalls it for a full tunnel round trip and vanishes from "
         "hostSyncCount. Every sync must ride packed_device_get (packed, "
         "accounted) or carry a suppression stating why it is deliberate — "
-        "the suppression set doubles as the library's host-sync census."
+        "the suppression set doubles as the library's host-sync census. "
+        "Since v2 the taint is interprocedural: a pull laundered through "
+        "helper functions is flagged at the call site with the chain."
     )
     example = "centers = np.asarray(dev_centroids)  # implicit D2H pull"
     scope = ("flink_ml_tpu",)
     # the funnel itself performs the one sanctioned transfer
     exclude = ("flink_ml_tpu/utils/packing.py",)
+    #: consult callee summaries (False = tpulint v1 per-function recall,
+    #: kept as the baseline the tier-1 superset test compares against)
+    interprocedural = True
 
     def check_module(
         self, project, module: SourceModule
@@ -288,15 +88,69 @@ class HostSyncLeakRule(Rule):
         if module.tree is None:
             return ()
         info = _jitindex.jit_index(project)[module.path]
-        findings: List[Finding] = []
+        graph = callgraph.get(project) if self.interprocedural else None
+        events: List[callgraph.SyncEvent] = []
+
+        covered = set()
+        if graph is not None:
+            for decl in graph.decls_in(module.path).values():
+                covered.add(id(decl.node))
+                events.extend(graph.analyze(decl).events)
+
+        def walk(body, params):
+            walker = callgraph.TaintWalker(
+                graph=graph, module=module, info=info, params=params
+            )
+            walker.run_block(body)
+            events.extend(walker.events)
+
+        # nested functions (and, without the call graph, every function)
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                tracker = _FunctionTaint(self, module, info, findings)
-                tracker.run_block(node.body)
+                if id(node) in covered:
+                    continue
+                params = {
+                    a.arg: i
+                    for i, a in enumerate(
+                        list(node.args.posonlyargs) + list(node.args.args)
+                    )
+                }
+                walk(node.body, params)
         # module level (rare, but kernels can be exercised at import)
-        tracker = _FunctionTaint(self, module, info, findings)
-        tracker.run_block(module.tree.body)
-        # nested functions are revisited by the outer ast.walk — dedup
+        walk(module.tree.body, {})
+
+        findings: List[Finding] = []
+        suppressed_here = module.suppressions_for(self.id)
+        for event in events:
+            if callgraph.DEVICE in event.sources:
+                findings.append(self._finding(module, event))
+            elif (
+                graph is not None
+                and not event.funcs
+                and event.kind in ("np-pull", "cast")
+                and event.line in suppressed_here
+            ):
+                # parameter-sourced sink under a suppression: the callee
+                # summary dropped it (documented deliberate sync) — emit
+                # the census finding so --show-suppressed lists it and the
+                # annotation cannot rot unused
+                findings.append(
+                    Finding(
+                        path=module.path,
+                        line=event.line,
+                        rule=self.id,
+                        message=(
+                            f"{'np.' if event.kind == 'np-pull' else ''}"
+                            f"{event.detail}"
+                            f"{'' if event.kind == 'np-pull' else '()'} on a "
+                            "function parameter is a blocking pull when "
+                            "callers pass device values — deliberate here "
+                            "(suppressed); callers inherit no finding"
+                        ),
+                        data=(f"{event.kind}-param", event.detail),
+                    )
+                )
+        # nested scopes can be revisited — dedup on (line, message)
         seen = set()
         unique = []
         for f in findings:
@@ -305,3 +159,42 @@ class HostSyncLeakRule(Rule):
                 seen.add(key)
                 unique.append(f)
         return unique
+
+    def _finding(self, module: SourceModule, event) -> Finding:
+        if event.funcs:
+            chain = " -> ".join(event.funcs)
+            sink = (
+                f"np.{event.detail}" if event.kind == "np-pull" else f"{event.detail}()"
+            )
+            message = (
+                f"device value passed to {event.funcs[0]}() is pulled to the "
+                f"host by {sink} at {event.sink_path}:{event.sink_line} "
+                f"(call chain: {chain}) — an implicit device->host sync "
+                "laundered through helpers; route the readback through "
+                "packed_device_get or keep the helper on device"
+            )
+            data = (f"{event.kind}-chain", event.detail) + tuple(event.funcs)
+        elif event.kind in _DIRECT_MESSAGES:
+            message = _DIRECT_MESSAGES[event.kind]
+            data = (event.detail,)
+        elif event.kind == "np-pull":
+            message = (
+                f"np.{event.detail} on a device value is an implicit device->host "
+                "pull — route it through packed_device_get (accounted, "
+                "packed) or keep the computation on the host branch"
+            )
+            data = ("np-pull", event.detail)
+        else:  # cast
+            message = (
+                f"{event.detail}() on a device value is a hidden blocking sync — "
+                "read it back through packed_device_get with the fit's "
+                "packed result instead"
+            )
+            data = ("cast", event.detail)
+        return Finding(
+            path=module.path,
+            line=event.line,
+            rule=self.id,
+            message=message,
+            data=data,
+        )

@@ -1,0 +1,472 @@
+"""lock-order: static lock-acquisition-order analysis (deadlock cycles).
+
+PR 8 gave the library a real multithreaded substrate — the flow pump and
+spawn workers, the serving dispatch loop, the prefetch stager, the epoch
+cache — and Spark-era experience (PAPERS.md) says coordination stalls,
+not FLOPs, dominate distributed-ML wall time. A lock inversion between
+two of those threads is silent on every test run that doesn't hit the
+exact interleaving, then deadlocks production. This rule holds the
+ordering invariant **statically**:
+
+- every ``threading.Lock`` / ``RLock`` / ``Condition`` creation in the
+  package becomes a lock *node* — module-level ``_lock = Lock()`` by
+  name, ``self._cv = Condition()`` by ``Class.attr`` (all instances of a
+  class share the node: a consistent class-level order is exactly the
+  discipline that keeps multi-instance locking safe);
+- every function is walked linearly tracking the *held set*: ``with
+  lock:`` blocks, explicit ``acquire()``/``release()`` pairs, and —
+  via the project call graph (`analysis/callgraph.py`) plus light local
+  type tracking (``ch = BoundedChannel(...)`` → ``ch.put(...)``) —
+  locks acquired transitively inside calls made while holding;
+- each "holding A, acquire B" observation is an edge A→B in the static
+  lock-acquisition graph; a **cycle** is a finding (the ABBA deadlock
+  shape), as is re-acquiring a non-reentrant ``Lock`` while already
+  holding it (self-deadlock; RLock/Condition are reentrant and exempt).
+
+The runtime half of the contract is ``analysis/sanitizer.py``: the
+``FLINK_ML_TPU_SANITIZE=1`` recorder observes the *actual* cross-thread
+acquisition DAG during tests and fails on cycles at process exit — the
+static rule catches the inversion before it runs, the sanitizer catches
+the lock the static pass could not see.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import callgraph
+from ..engine import Finding, Rule, register
+from ..source import SourceModule, dotted_name
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+_REENTRANT = ("RLock", "Condition")
+
+
+@dataclass(frozen=True)
+class LockNode:
+    node_id: str  # e.g. "flink_ml_tpu.flow.BoundedChannel._cv"
+    kind: str  # Lock | RLock | Condition
+    path: str
+    line: int
+
+
+@dataclass
+class _EdgeSite:
+    path: str
+    line: int
+    via: str  # "" for a direct nested with, else the callee qualname
+
+
+@dataclass
+class _ModuleLocks:
+    threading_aliases: Set[str] = field(default_factory=set)  # `import threading as t`
+    factory_aliases: Dict[str, str] = field(default_factory=dict)  # `from threading import Lock as L`
+    module_locks: Dict[str, LockNode] = field(default_factory=dict)
+    class_locks: Dict[str, Dict[str, LockNode]] = field(default_factory=dict)
+
+
+def _lock_factory_kind(call: ast.AST, locks: _ModuleLocks) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when ``call`` constructs one."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    root, _, rest = name.partition(".")
+    if root in locks.threading_aliases and rest in _LOCK_FACTORIES:
+        return rest
+    if not rest and name in locks.factory_aliases:
+        return locks.factory_aliases[name]
+    return None
+
+
+def _collect_module_locks(module: SourceModule) -> _ModuleLocks:
+    locks = _ModuleLocks()
+    if module.tree is None:
+        return locks
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "threading":
+                    locks.threading_aliases.add(a.asname or "threading")
+        elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for a in node.names:
+                if a.name in _LOCK_FACTORIES:
+                    locks.factory_aliases[a.asname or a.name] = a.name
+
+    def declare(name: str, cls: Optional[str], call: ast.Call, kind: str) -> None:
+        # Condition(existing_lock) shares the wrapped lock's node — and
+        # its (non-)reentrancy
+        if kind == "Condition" and call.args:
+            wrapped = _resolve_static_lock(call.args[0], locks, cls)
+            if wrapped is not None:
+                target = locks.class_locks.setdefault(cls, {}) if cls else locks.module_locks
+                target[name] = wrapped
+                return
+        qual = f"{module.module_name}.{cls}.{name}" if cls else f"{module.module_name}.{name}"
+        node_obj = LockNode(node_id=qual, kind=kind, path=module.path, line=call.lineno)
+        if cls:
+            locks.class_locks.setdefault(cls, {})[name] = node_obj
+        else:
+            locks.module_locks[name] = node_obj
+
+    # module-level and class-level assignments; self.attr = ... in methods
+    for top in module.tree.body:
+        if isinstance(top, ast.Assign) and len(top.targets) == 1:
+            target = top.targets[0]
+            kind = _lock_factory_kind(top.value, locks)
+            if kind and isinstance(target, ast.Name):
+                declare(target.id, None, top.value, kind)
+        elif isinstance(top, ast.ClassDef):
+            for item in ast.walk(top):
+                if not isinstance(item, ast.Assign) or len(item.targets) != 1:
+                    continue
+                kind = _lock_factory_kind(item.value, locks)
+                if not kind:
+                    continue
+                target = item.targets[0]
+                if isinstance(target, ast.Name):  # class attribute
+                    declare(target.id, top.name, item.value, kind)
+                elif (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    declare(target.attr, top.name, item.value, kind)
+    return locks
+
+
+def _resolve_static_lock(
+    expr: ast.AST, locks: _ModuleLocks, current_class: Optional[str]
+) -> Optional[LockNode]:
+    """A lock expression (`_lock`, `self._cv`) to its node, else None."""
+    if isinstance(expr, ast.Name):
+        return locks.module_locks.get(expr.id)
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id in ("self", "cls")
+        and current_class
+    ):
+        return locks.class_locks.get(current_class, {}).get(expr.attr)
+    return None
+
+
+class _FunctionLockWalker:
+    """Linear walk of one function: records lock-order edges and the set
+    of locks the function may acquire (for transitive call edges)."""
+
+    def __init__(self, analysis: "_ProjectLockAnalysis", decl, module, locks):
+        self.analysis = analysis
+        self.decl = decl
+        self.module = module
+        self.locks = locks
+        self.current_class = decl.qualname.split(".")[0] if decl.is_method else None
+        self.acquired: Set[LockNode] = set()
+        self.local_aliases: Dict[str, LockNode] = {}
+        self.local_types: Dict[str, Tuple[str, str]] = {}  # name -> (path, class)
+
+    # -- resolution ----------------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[LockNode]:
+        if isinstance(expr, ast.Name) and expr.id in self.local_aliases:
+            return self.local_aliases[expr.id]
+        return _resolve_static_lock(expr, self.locks, self.current_class)
+
+    def _constructed_type(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        """(module_path, ClassName) when ``value`` constructs a class the
+        project declares (local or one-hop imported, incl. `flow.X(...)`)."""
+        if not isinstance(value, ast.Call):
+            return None
+        graph = self.analysis.graph
+        func = value.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if self.analysis.has_class(self.module.path, name):
+                return (self.module.path, name)
+            info = graph.jitindex.get(self.module.path)
+            if info is not None and name in info.imports:
+                target_module, original = info.imports[name]
+                target_path = graph.module_paths.get(target_module)
+                if target_path and self.analysis.has_class(target_path, original):
+                    return (target_path, original)
+        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            info = graph.jitindex.get(self.module.path)
+            if info is not None and func.value.id in info.imports:
+                target_module, original = info.imports[func.value.id]
+                target_path = graph.module_paths.get(f"{target_module}.{original}")
+                if target_path and self.analysis.has_class(target_path, func.attr):
+                    return (target_path, func.attr)
+        return None
+
+    def _callee_acquires(self, call: ast.Call) -> Tuple[Set[LockNode], str]:
+        """Locks a call may acquire (transitively), with a label."""
+        graph = self.analysis.graph
+        resolved = graph.resolve(self.module, call.func, self.current_class)
+        if resolved is not None:
+            decl, _ = resolved
+            return self.analysis.acquires(decl), decl.qualname
+        # typed local: ch.put(...) where ch = BoundedChannel(...)
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            typed = self.local_types.get(func.value.id)
+            if typed is not None:
+                path, cls = typed
+                decl = graph.decls_in(path).get(f"{cls}.{func.attr}")
+                if decl is not None:
+                    return self.analysis.acquires(decl), decl.qualname
+        return set(), ""
+
+    # -- the walk ------------------------------------------------------------
+    def run(self) -> Set[LockNode]:
+        self._block(self.decl.node.body, [])
+        return self.acquired
+
+    def _note_acquire(self, node: LockNode, held: List[LockNode], line: int, via: str) -> None:
+        self.acquired.add(node)
+        for holder in held:
+            self.analysis.add_edge(
+                holder, node, _EdgeSite(path=self.module.path, line=line, via=via)
+            )
+
+    def _scan_calls(self, stmt: ast.stmt, held: List[LockNode]) -> None:
+        from . import _astwalk
+
+        for header in _astwalk.header_nodes(stmt):
+            for node in ast.walk(header):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr in (
+                    "wait",
+                    "notify",
+                    "notify_all",
+                    "locked",
+                ):
+                    continue  # condition-variable ops on an already-held lock
+                if isinstance(func, ast.Attribute) and func.attr in ("acquire", "release"):
+                    continue  # handled linearly by _block
+                targets, via = self._callee_acquires(node)
+                for target in sorted(targets, key=lambda n: n.node_id):
+                    self._note_acquire(target, held, node.lineno, via)
+
+    def _block(self, body: Sequence[ast.stmt], held: List[LockNode]) -> None:
+        held = list(held)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # separate scope
+            # explicit acquire()/release() pairs, tracked linearly
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and call.func.attr in (
+                    "acquire",
+                    "release",
+                ):
+                    node = self._lock_of(call.func.value)
+                    if node is not None:
+                        if call.func.attr == "acquire":
+                            self._note_acquire(node, held, call.lineno, "")
+                            held.append(node)
+                        elif node in held:
+                            held.remove(node)
+                        continue
+            self._scan_calls(stmt, held)
+            # alias / type tracking
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    alias = self._lock_of(stmt.value)
+                    if alias is not None:
+                        self.local_aliases[target.id] = alias
+                    else:
+                        self.local_aliases.pop(target.id, None)
+                        typed = self._constructed_type(stmt.value)
+                        if typed is not None:
+                            self.local_types[target.id] = typed
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                entered: List[LockNode] = []
+                for item in stmt.items:
+                    node = self._lock_of(item.context_expr)
+                    if node is not None:
+                        self._note_acquire(node, held, stmt.lineno, "")
+                        entered.append(node)
+                self._block(stmt.body, held + entered)
+                continue
+            for block in (
+                getattr(stmt, "body", None),
+                getattr(stmt, "orelse", None),
+                getattr(stmt, "finalbody", None),
+            ):
+                if block and isinstance(block, list):
+                    self._block(block, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._block(handler.body, held)
+
+
+class _ProjectLockAnalysis:
+    def __init__(self, project, scope_paths: Sequence[str]):
+        self.project = project
+        self.graph = callgraph.get(project)
+        self.module_locks: Dict[str, _ModuleLocks] = {}
+        self.edges: Dict[LockNode, Dict[LockNode, List[_EdgeSite]]] = {}
+        self._acquires: Dict[Tuple[str, str], Set[LockNode]] = {}
+        self._in_progress: Set[Tuple[str, str]] = set()
+        self._classes: Dict[str, Set[str]] = {}
+        for module in project.modules:
+            self.module_locks[module.path] = _collect_module_locks(module)
+            classes: Set[str] = set()
+            if module.tree is not None:
+                for top in module.tree.body:
+                    if isinstance(top, ast.ClassDef):
+                        classes.add(top.name)
+            self._classes[module.path] = classes
+        # drive the edge collection from every function in scope
+        for module in project.modules:
+            if not any(
+                module.path == p or module.path.startswith(p.rstrip("/") + "/")
+                for p in scope_paths
+            ):
+                continue
+            for decl in self.graph.decls_in(module.path).values():
+                self.acquires(decl)
+
+    def has_class(self, path: str, name: str) -> bool:
+        return name in self._classes.get(path, set())
+
+    def add_edge(self, holder: LockNode, target: LockNode, site: _EdgeSite) -> None:
+        self.edges.setdefault(holder, {}).setdefault(target, []).append(site)
+
+    def acquires(self, decl) -> Set[LockNode]:
+        """Locks ``decl`` may acquire, transitively; memoized and
+        cycle-guarded (recursion contributes the empty set)."""
+        key = decl.key
+        if key in self._acquires:
+            return self._acquires[key]
+        if key in self._in_progress:
+            return set()
+        self._in_progress.add(key)
+        try:
+            module = self.project.module_at(decl.path)
+            locks = self.module_locks.get(decl.path, _ModuleLocks())
+            walker = _FunctionLockWalker(self, decl, module, locks)
+            acquired = walker.run()
+        finally:
+            self._in_progress.discard(key)
+        self._acquires[key] = acquired
+        return acquired
+
+    # -- cycle detection -----------------------------------------------------
+    def cycles(self) -> List[List[LockNode]]:
+        """Elementary cycles worth reporting: one representative per
+        strongly-connected component of size > 1, plus non-reentrant
+        self-loops."""
+        out: List[List[LockNode]] = []
+        nodes = sorted(self.edges, key=lambda n: n.node_id)
+        for node in nodes:
+            sites = self.edges.get(node, {}).get(node)
+            if sites and node.kind not in _REENTRANT:
+                out.append([node])
+        # DFS-based cycle search over the (small) lock graph
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: LockNode, current: LockNode, path: List[LockNode]) -> None:
+            for nxt in sorted(self.edges.get(current, {}), key=lambda n: n.node_id):
+                if nxt == start and len(path) > 1:
+                    # canonical rotation for dedup
+                    ids = [n.node_id for n in path]
+                    pivot = ids.index(min(ids))
+                    key = tuple(ids[pivot:] + ids[:pivot])
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(list(path))
+                elif nxt not in path and nxt.node_id > start.node_id:
+                    # only walk "later" nodes: each cycle found once, from
+                    # its smallest member
+                    dfs(start, nxt, path + [nxt])
+
+        for node in nodes:
+            dfs(node, node, [node])
+        return out
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    title = "lock-acquisition-order cycles (static deadlock detection)"
+    rationale = (
+        "Two threads taking the same pair of locks in opposite orders "
+        "deadlock on the first adverse interleaving — which no CPU test "
+        "schedule may ever produce, and production will. The rule builds "
+        "the static lock-acquisition graph over every threading.Lock/"
+        "RLock/Condition in the package (with-blocks, acquire/release "
+        "pairs, and locks taken inside calls made while holding, resolved "
+        "through the project call graph); a cycle, or a re-acquire of a "
+        "non-reentrant Lock, is a finding. Acquire locks in one global "
+        "order, or split the critical section so no call is made while "
+        "holding. The runtime twin is the FLINK_ML_TPU_SANITIZE=1 "
+        "recorder (analysis/sanitizer.py)."
+    )
+    example = (
+        "with self._a:\n"
+        "    with self._b: ...   # thread 1: a -> b\n"
+        "with self._b:\n"
+        "    with self._a: ...   # thread 2: b -> a  -> cycle finding"
+    )
+    scope = ("flink_ml_tpu",)
+
+    def check_project(self, project) -> Iterable[Finding]:
+        analysis = _ProjectLockAnalysis(project, self.scope)
+        findings: List[Finding] = []
+        for cycle in analysis.cycles():
+            if len(cycle) == 1:
+                node = cycle[0]
+                site = sorted(
+                    analysis.edges[node][node], key=lambda s: (s.path, s.line)
+                )[0]
+                via = f" (via {site.via})" if site.via else ""
+                findings.append(
+                    Finding(
+                        path=site.path,
+                        line=site.line,
+                        rule=self.id,
+                        message=(
+                            f"non-reentrant lock {node.node_id} ({node.kind}) "
+                            f"re-acquired while already held{via} — "
+                            "self-deadlock; use an RLock or restructure the "
+                            "critical section"
+                        ),
+                        data=("self-deadlock", node.node_id),
+                    )
+                )
+                continue
+            # describe every edge of the cycle, anchor at the first site
+            legs = []
+            anchor: Optional[_EdgeSite] = None
+            for i, node in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                site = sorted(
+                    analysis.edges[node][nxt], key=lambda s: (s.path, s.line)
+                )[0]
+                via = f" via {site.via}" if site.via else ""
+                legs.append(
+                    f"{node.node_id} -> {nxt.node_id} at {site.path}:{site.line}{via}"
+                )
+                if anchor is None or (site.path, site.line) < (anchor.path, anchor.line):
+                    anchor = site
+            order = " -> ".join(n.node_id for n in cycle + [cycle[0]])
+            findings.append(
+                Finding(
+                    path=anchor.path,
+                    line=anchor.line,
+                    rule=self.id,
+                    message=(
+                        f"lock-order cycle {order}: "
+                        + "; ".join(legs)
+                        + " — two threads interleaving these acquisitions "
+                        "deadlock; impose one global acquisition order"
+                    ),
+                    data=("cycle",) + tuple(n.node_id for n in cycle),
+                )
+            )
+        return sorted(findings, key=lambda f: (f.path, f.line, f.message))

@@ -1,0 +1,388 @@
+"""Runtime concurrency sanitizer — the dynamic twin of the static rules.
+
+``FLINK_ML_TPU_SANITIZE=1`` turns the test run into a concurrency
+recorder: every ``flow.BoundedChannel`` condition variable (and the obs
+tracing lock) is wrapped so acquisitions are observed, every
+``flow.pump``/``flow.spawn`` worker is registered, and every channel's
+open→close lifecycle is balanced. At process exit (or pytest session
+end — see tests/conftest.py) the recorder fails on:
+
+- **lock-order cycles** in the observed cross-thread acquisition DAG —
+  the edge A→B is recorded when a thread *attempts* B while holding A
+  (attempt-time, so a real deadlock still leaves its evidence), and a
+  cycle means two code paths disagree about the global order;
+- **leaked workers** — a pump/spawn thread still alive after a bounded
+  join: its consumer abandoned it without the close/cancel handshake,
+  the silently-stalled-worker state the flow contract exists to kill;
+- **unclosed pump channels** — a channel that had a producer worker
+  attached but was never closed (by the worker) or cancelled (by the
+  consumer).
+
+The static rules (`lock-order`, `channel-protocol`) prove the *code*
+cannot express an inversion the analyzer can see; the sanitizer proves
+the *executions the tests actually drove* stayed clean — each covers the
+other's blind spot (dynamic dispatch the analyzer had to skip; the
+interleaving the tests never ran). Both report the same hazard class in
+the same vocabulary (docs/static_analysis.md).
+
+Everything here is dependency-free host plumbing: safe to import before
+jax, cheap enough to leave on for a whole suite (one dict update per
+lock op, under the recorder's own internal lock).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_FLAG = "FLINK_ML_TPU_SANITIZE"
+
+__all__ = [
+    "SanitizerError",
+    "Recorder",
+    "recorder",
+    "enabled_by_env",
+    "enable",
+    "tracked_lock",
+    "tracked_rlock",
+    "tracked_condition",
+]
+
+
+class SanitizerError(AssertionError):
+    """Raised by :func:`check` when the recorded execution violated the
+    concurrency contract (cycle / leaked worker / unclosed channel)."""
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip() not in ("", "0", "false", "off")
+
+
+class Recorder:
+    """The global acquisition-DAG + worker/channel ledger."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()  # guards the ledgers; never held while blocking
+        self._held = threading.local()  # per-thread stack of lock names
+        # (holder, acquired) -> sample: (thread name, count)
+        self.edges: Dict[Tuple[str, str], List] = {}
+        self.acquisitions = 0
+        # id(channel) -> [name, pumped, closed]
+        self._channels: Dict[int, List] = {}
+        self._workers: List[Tuple[threading.Thread, str]] = []
+
+    # -- lock events ---------------------------------------------------------
+    def _stack(self) -> List[str]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = []
+            self._held.stack = stack
+        return stack
+
+    def on_attempt(self, name: str) -> None:
+        """Record edges BEFORE blocking on the acquire, so a genuine
+        deadlock still leaves the inversion in the ledger."""
+        stack = self._stack()
+        if not stack:
+            return
+        thread = threading.current_thread().name
+        with self._mu:
+            for holder in stack:
+                if holder == name:
+                    continue  # reentrant re-acquire, not an ordering edge
+                entry = self.edges.setdefault((holder, name), [thread, 0])
+                entry[1] += 1
+
+    def on_acquired(self, name: str) -> None:
+        self._stack().append(name)
+        with self._mu:
+            self.acquisitions += 1
+
+    def on_release(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- channel / worker ledger ---------------------------------------------
+    def register_channel(self, channel) -> None:
+        with self._mu:
+            self._channels[id(channel)] = [getattr(channel, "name", "channel"), False, False]
+
+    def channel_pumped(self, channel) -> None:
+        with self._mu:
+            entry = self._channels.get(id(channel))
+            if entry is not None:
+                entry[1] = True
+
+    def channel_closed(self, channel) -> None:
+        with self._mu:
+            entry = self._channels.get(id(channel))
+            if entry is not None:
+                entry[2] = True
+
+    def register_worker(self, thread: threading.Thread, kind: str) -> None:
+        with self._mu:
+            self._workers.append((thread, kind))
+
+    # -- verdicts ------------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the recorded acquisition DAG (one
+        representative per cycle, smallest node first)."""
+        with self._mu:
+            adjacency: Dict[str, Set[str]] = {}
+            for holder, acquired in self.edges:
+                adjacency.setdefault(holder, set()).add(acquired)
+        out: List[List[str]] = []
+        seen: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, current: str, path: List[str]) -> None:
+            for nxt in sorted(adjacency.get(current, ())):
+                if nxt == start:
+                    key = tuple(path)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(list(path))
+                elif nxt not in path and nxt > start:
+                    dfs(start, nxt, path + [nxt])
+
+        for node in sorted(adjacency):
+            dfs(node, node, [node])
+        return out
+
+    def problems(self, join_timeout: float = 2.0) -> List[str]:
+        """Everything wrong with the recorded execution, as messages."""
+        out: List[str] = []
+        for cycle in self.cycles():
+            order = " -> ".join(cycle + [cycle[0]])
+            with self._mu:
+                evidence = "; ".join(
+                    f"{a}->{b} (thread {self.edges[(a, b)][0]}, x{self.edges[(a, b)][1]})"
+                    for a, b in zip(cycle, cycle[1:] + [cycle[0]])
+                    if (a, b) in self.edges
+                )
+            out.append(f"lock-order cycle: {order} [{evidence}]")
+        with self._mu:
+            workers = list(self._workers)
+            channels = list(self._channels.values())
+        for thread, kind in workers:
+            if thread.is_alive():
+                thread.join(join_timeout)
+            if thread.is_alive():
+                out.append(
+                    f"leaked worker: {kind} thread {thread.name!r} still "
+                    "alive at exit — its consumer never closed/cancelled "
+                    "the handshake channel"
+                )
+        for name, pumped, closed in channels:
+            if pumped and not closed:
+                out.append(
+                    f"unclosed pump channel {name!r}: a producer worker was "
+                    "attached but close()/cancel() never ran"
+                )
+        return out
+
+    def check(self, join_timeout: float = 2.0) -> None:
+        found = self.problems(join_timeout)
+        if found:
+            raise SanitizerError(
+                "concurrency sanitizer: "
+                + "; ".join(found)
+                + f" (after {self.acquisitions} recorded acquisitions)"
+            )
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            return {
+                "acquisitions": self.acquisitions,
+                "edges": len(self.edges),
+                "channels": len(self._channels),
+                "channelsClosed": sum(1 for c in self._channels.values() if c[2]),
+                "workers": len(self._workers),
+            }
+
+
+#: the process-wide recorder (fresh instances are for unit tests)
+recorder = Recorder()
+
+
+# ---------------------------------------------------------------------------
+# tracked lock wrappers
+# ---------------------------------------------------------------------------
+
+class _TrackedBase:
+    """Context-manager + acquire/release shim over a real lock object,
+    reporting to a :class:`Recorder`."""
+
+    def __init__(self, name: str, rec: Optional[Recorder] = None, inner=None):
+        self._name = name
+        self._rec = rec if rec is not None else recorder
+        self._inner = inner
+
+    def acquire(self, *args, **kwargs):
+        self._rec.on_attempt(self._name)
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._rec.on_acquired(self._name)
+        return got
+
+    def release(self):
+        self._rec.on_release(self._name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class TrackedLock(_TrackedBase):
+    def __init__(self, name: str, rec: Optional[Recorder] = None, inner=None):
+        super().__init__(name, rec, inner if inner is not None else threading.Lock())
+
+
+class TrackedRLock(_TrackedBase):
+    def __init__(self, name: str, rec: Optional[Recorder] = None, inner=None):
+        super().__init__(name, rec, inner if inner is not None else threading.RLock())
+
+
+class TrackedCondition(_TrackedBase):
+    """Condition wrapper: the wait() internal release/re-acquire is
+    reported too, so the held-stack stays truthful across waits."""
+
+    def __init__(self, name: str, rec: Optional[Recorder] = None, inner=None):
+        super().__init__(
+            name, rec, inner if inner is not None else threading.Condition()
+        )
+
+    def wait(self, timeout: Optional[float] = None):
+        self._rec.on_release(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._rec.on_acquired(self._name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._rec.on_release(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._rec.on_acquired(self._name)
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+
+def tracked_lock(name: str, rec: Optional[Recorder] = None) -> TrackedLock:
+    return TrackedLock(name, rec)
+
+
+def tracked_rlock(name: str, rec: Optional[Recorder] = None) -> TrackedRLock:
+    return TrackedRLock(name, rec)
+
+
+def tracked_condition(name: str, rec: Optional[Recorder] = None) -> TrackedCondition:
+    return TrackedCondition(name, rec)
+
+
+# ---------------------------------------------------------------------------
+# instrumentation
+# ---------------------------------------------------------------------------
+
+_enabled = False
+_exit_checked = False
+
+
+def enable(register_atexit: bool = True) -> None:
+    """Instrument the flow layer (idempotent). Called automatically by
+    tests/conftest.py when ``FLINK_ML_TPU_SANITIZE=1``; safe to call
+    directly from a driver process."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+
+    from .. import flow
+    from ..obs import tracing
+
+    orig_init = flow.BoundedChannel.__init__
+    orig_close = flow.BoundedChannel.close
+    orig_cancel = flow.BoundedChannel.cancel
+    orig_pump = flow.pump
+    orig_spawn = flow.spawn
+
+    def init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        self._cv = TrackedCondition(
+            f"flow.BoundedChannel._cv[{self.name}]", recorder, inner=self._cv
+        )
+        recorder.register_channel(self)
+
+    def close(self, error=None):
+        recorder.channel_closed(self)
+        return orig_close(self, error=error)
+
+    def cancel(self):
+        recorder.channel_closed(self)
+        return orig_cancel(self)
+
+    def pump(items, channel, transform=None, watchdog=None):
+        recorder.channel_pumped(channel)
+        worker = orig_pump(items, channel, transform=transform, watchdog=watchdog)
+        recorder.register_worker(worker, "pump")
+        return worker
+
+    def spawn(fn, name="worker"):
+        worker = orig_spawn(fn, name=name)
+        recorder.register_worker(worker, "spawn")
+        return worker
+
+    flow.BoundedChannel.__init__ = init
+    flow.BoundedChannel.close = close
+    flow.BoundedChannel.cancel = cancel
+    flow.pump = pump
+    flow.spawn = spawn
+    # the obs tracing lock joins the DAG (the only other lock in the tree)
+    tracing._lock = TrackedLock("obs.tracing._lock", recorder, inner=tracing._lock)
+
+    if register_atexit:
+        atexit.register(_atexit_check)
+
+
+def mark_exit_checked() -> None:
+    """A harness (pytest sessionfinish) already ran the exit check; the
+    atexit fallback becomes a no-op."""
+    global _exit_checked
+    _exit_checked = True
+
+
+def _atexit_check() -> None:
+    if _exit_checked:
+        return
+    found = recorder.problems()
+    if found:
+        sys.stderr.write(
+            "FLINK_ML_TPU_SANITIZE: concurrency violations at exit:\n"
+            + "".join(f"  - {p}\n" for p in found)
+        )
+        sys.stderr.flush()
+        os._exit(66)  # atexit cannot change the exit status any other way
+    sys.stderr.write(
+        "FLINK_ML_TPU_SANITIZE: clean "
+        f"({recorder.stats()['acquisitions']} acquisitions, "
+        f"{recorder.stats()['workers']} workers, "
+        f"{recorder.stats()['channels']} channels)\n"
+    )

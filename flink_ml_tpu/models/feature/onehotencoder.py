@@ -131,6 +131,7 @@ class OneHotEncoderModel(Model, OneHotEncoderModelParams):
                 from ...obs import tracing
 
                 tracing.account_host_sync("transform")
+                # tpulint: disable=host-sync-leak -- deliberate: one validation scalar probe, accounted via account_host_sync above
                 if bool(bad):
                     raise ValueError(
                         f"The input contains an invalid (non-integer, negative "

@@ -101,7 +101,11 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
         # device in -> device out (the LR/SVC convention): materializing
         # here would pull the whole prediction vector through the tunnel
         if not _linear.is_device_column(col):
-            pred = np.asarray(pred, dtype=np.float64)
+            from ...utils.packing import packed_device_get
+
+            # one packed, accounted readback (np.asarray was a silent pull)
+            (pred_h,) = packed_device_get(pred, sync_kind="transform")
+            pred = pred_h.astype(np.float64)
         return [table.with_column(self.get_prediction_col(), pred)]
 
     def _save_extra(self, path: str) -> None:

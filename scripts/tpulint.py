@@ -11,6 +11,8 @@ Usage:
   scripts/tpulint.py --rule host-sync-leak [--rule ...]   # subset of rules
   scripts/tpulint.py path/to/file.py [...]                # subset of files
   scripts/tpulint.py --show-suppressed   # also print what suppressions hid
+  scripts/tpulint.py --format json       # machine-readable findings
+                                         # (file/line/rule/message/chain)
 
 Exit status: 0 when there are no unsuppressed findings, 1 otherwise.
 Suppress a deliberate finding with an inline (or preceding-line) comment:
@@ -25,6 +27,7 @@ docs/static_analysis.md.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -35,29 +38,62 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from flink_ml_tpu.analysis import engine  # noqa: E402
 
 
-def _changed_files(root: str) -> list:
+def _changed_files(root: str):
     """Repo-relative .py files differing from HEAD (staged, unstaged, and
-    untracked)."""
-    out = subprocess.run(
-        ["git", "diff", "--name-only", "HEAD"],
-        cwd=root,
-        capture_output=True,
-        text=True,
-        check=True,
-    ).stdout
-    untracked = subprocess.run(
-        ["git", "ls-files", "--others", "--exclude-standard"],
-        cwd=root,
-        capture_output=True,
-        text=True,
-        check=True,
-    ).stdout
+    untracked). Robust to renames (the NEW path is linted, the old one —
+    which exists only in HEAD — is skipped) and deletions (nothing on
+    disk to lint). Returns None when ``root`` is not a git checkout with
+    a HEAD — the caller falls back to a full lint instead of crashing."""
+
+    def git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True
+        )
+
+    # -M: rename detection, so a renamed file is one R row (new path),
+    # not a D row for a path that exists only in HEAD plus an A row
+    diff = git("diff", "--name-status", "-M", "HEAD")
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    candidates = []
+    for line in diff.stdout.splitlines():
+        parts = line.split("\t")
+        if len(parts) < 2:
+            continue
+        status = parts[0].strip()
+        if status.startswith("D"):
+            continue  # deleted: exists only in HEAD, nothing to lint
+        # R<score>/C<score> rows are "old<TAB>new": lint the new path
+        candidates.append(parts[-1].strip())
+    candidates.extend(line.strip() for line in untracked.stdout.splitlines())
     files = []
-    for line in (out + untracked).splitlines():
-        line = line.strip()
-        if line.endswith(".py") and os.path.exists(os.path.join(root, line)):
-            files.append(line)
+    for rel in candidates:
+        if rel.endswith(".py") and os.path.exists(os.path.join(root, rel)):
+            files.append(rel)
     return sorted(set(files))
+
+
+def _chain_of(finding) -> list:
+    """The interprocedural call chain a finding carries, when any (the
+    host-sync laundering chain, a lock-order cycle's node ring)."""
+    data = getattr(finding, "data", ()) or ()
+    if data and isinstance(data[0], str):
+        if data[0].endswith("-chain"):
+            return [str(x) for x in data[2:]]
+        if data[0] == "cycle":
+            return [str(x) for x in data[1:]]
+    return []
+
+
+def _finding_json(finding) -> dict:
+    return {
+        "file": finding.path,
+        "line": finding.line,
+        "rule": finding.rule,
+        "message": finding.message,
+        "chain": _chain_of(finding),
+    }
 
 
 def _list_rules() -> int:
@@ -106,6 +142,13 @@ def main(argv=None) -> int:
         help="also print findings hidden by suppressions (the sync census)",
     )
     parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format: json emits one machine-readable object "
+        "(findings + suppressed census, each with file/line/rule/chain)",
+    )
+    parser.add_argument(
         "--root",
         default=None,
         help="lint a different tree root (fixture trees in tests; the "
@@ -130,8 +173,17 @@ def main(argv=None) -> int:
     only_paths = None
     if args.changed:
         only_paths = _changed_files(root)
-        if not only_paths:
-            print("tpulint: no files differ from HEAD")
+        if only_paths is None:
+            print(
+                "tpulint: --changed needs a git checkout with a HEAD; "
+                "linting the whole tree instead",
+                file=sys.stderr,
+            )
+        elif not only_paths:
+            if args.format == "json":
+                print(json.dumps({"clean": True, "findings": [], "suppressed": []}))
+            else:
+                print("tpulint: no files differ from HEAD")
             return 0
     if args.paths:
         normalized = [
@@ -145,6 +197,19 @@ def main(argv=None) -> int:
         )
 
     report = engine.run(root=root, rules=rules, only_paths=only_paths)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "clean": not report.findings,
+                    "findings": [_finding_json(f) for f in report.findings],
+                    "suppressed": [_finding_json(f) for f in report.suppressed],
+                },
+                indent=2,
+            )
+        )
+        return report.exit_code
 
     if args.show_suppressed and report.suppressed:
         print(f"-- {len(report.suppressed)} suppressed finding(s):")

@@ -22,6 +22,35 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Concurrency sanitizer (docs/static_analysis.md): FLINK_ML_TPU_SANITIZE=1
+# wraps every flow-layer lock/channel/worker and fails the session on
+# recorded lock-order cycles, leaked workers, or unclosed pump channels —
+# the runtime cross-check of the static lock-order/channel-protocol rules.
+from flink_ml_tpu.analysis import sanitizer  # noqa: E402
+
+if sanitizer.enabled_by_env():
+    sanitizer.enable(register_atexit=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not (sanitizer.enabled_by_env() and exitstatus == 0):
+        return
+    problems = sanitizer.recorder.problems()
+    sanitizer.mark_exit_checked()
+    reporter = session.config.pluginmanager.get_plugin("terminalreporter")
+    emit = reporter.write_line if reporter else print
+    if problems:
+        for problem in problems:
+            emit(f"FLINK_ML_TPU_SANITIZE: {problem}")
+        session.exitstatus = 1
+    else:
+        stats = sanitizer.recorder.stats()
+        emit(
+            "FLINK_ML_TPU_SANITIZE: clean — "
+            f"{stats['acquisitions']} acquisitions, {stats['workers']} workers, "
+            f"{stats['channelsClosed']}/{stats['channels']} channels closed"
+        )
+
 
 @pytest.fixture
 def mesh8():

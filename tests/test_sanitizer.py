@@ -1,0 +1,201 @@
+"""Runtime concurrency sanitizer (flink_ml_tpu/analysis/sanitizer.py):
+the FLINK_ML_TPU_SANITIZE=1 recorder must (a) catch a real ABBA deadlock
+pattern provoked on a throwaway pair of locks, (b) stay quiet on
+consistently-ordered acquisitions, (c) balance the channel/worker ledger
+(leaked workers and unclosed pump channels fail at exit), and (d) do all
+of it end-to-end through the instrumented flow layer in a subprocess."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from flink_ml_tpu.analysis import sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestLockOrderRecorder:
+    def test_abba_cycle_detected_sequentially(self):
+        rec = sanitizer.Recorder()
+        a = sanitizer.TrackedLock("test.A", rec)
+        b = sanitizer.TrackedLock("test.B", rec)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        for fn in (t1, t2):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        cycles = rec.cycles()
+        assert cycles, "inverted acquisition order must record a cycle"
+        assert sorted(cycles[0]) == ["test.A", "test.B"]
+        with pytest.raises(sanitizer.SanitizerError) as err:
+            rec.check()
+        assert "test.A" in str(err.value) and "test.B" in str(err.value)
+
+    def test_real_abba_deadlock_pattern(self):
+        """Both threads take their first lock, THEN attempt the other —
+        the genuine deadlock interleaving. Timed second acquires keep the
+        test finite; the attempt-time edges still pin the cycle."""
+        rec = sanitizer.Recorder()
+        a = sanitizer.TrackedLock("abba.A", rec)
+        b = sanitizer.TrackedLock("abba.B", rec)
+        barrier = threading.Barrier(2)
+        blocked = []
+
+        def worker(first, second):
+            with first:
+                barrier.wait()  # both hold their first lock: deadlock is live
+                got = second.acquire(timeout=0.2)
+                if got:
+                    second.release()
+                else:
+                    blocked.append(second._name)
+
+        t1 = threading.Thread(target=worker, args=(a, b))
+        t2 = threading.Thread(target=worker, args=(b, a))
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert blocked, "at least one second acquire must have been blocked"
+        cycles = rec.cycles()
+        assert cycles and sorted(cycles[0]) == ["abba.A", "abba.B"]
+
+    def test_consistent_order_is_clean(self):
+        rec = sanitizer.Recorder()
+        a = sanitizer.TrackedLock("ok.A", rec)
+        b = sanitizer.TrackedLock("ok.B", rec)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert rec.cycles() == []
+        rec.check()  # no raise
+
+    def test_reentrant_reacquire_is_not_an_edge(self):
+        rec = sanitizer.Recorder()
+        r = sanitizer.TrackedRLock("re.R", rec)
+        with r:
+            with r:
+                pass
+        assert rec.edges == {}
+        assert rec.cycles() == []
+
+    def test_condition_wait_keeps_held_stack_truthful(self):
+        rec = sanitizer.Recorder()
+        cv = sanitizer.TrackedCondition("cv.C", rec)
+        other = sanitizer.TrackedLock("cv.L", rec)
+        with cv:
+            cv.wait(timeout=0.01)
+        with other:
+            pass
+        # cv was fully released before `other` was taken: no edge
+        assert ("cv.C", "cv.L") not in rec.edges
+
+
+class _FakeChannel:
+    def __init__(self, name):
+        self.name = name
+
+
+class TestLedger:
+    def test_unclosed_pump_channel_is_a_problem(self):
+        rec = sanitizer.Recorder()
+        ch = _FakeChannel("leaky")
+        rec.register_channel(ch)
+        rec.channel_pumped(ch)
+        problems = rec.problems(join_timeout=0.01)
+        assert any("leaky" in p and "unclosed" in p for p in problems)
+        rec.channel_closed(ch)
+        assert rec.problems(join_timeout=0.01) == []
+
+    def test_unpumped_channel_needs_no_close(self):
+        rec = sanitizer.Recorder()
+        ch = _FakeChannel("scratch")
+        rec.register_channel(ch)
+        assert rec.problems(join_timeout=0.01) == []
+
+    def test_leaked_worker_is_a_problem(self):
+        rec = sanitizer.Recorder()
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, daemon=True)
+        t.start()
+        rec.register_worker(t, "spawn")
+        problems = rec.problems(join_timeout=0.05)
+        assert any("leaked worker" in p for p in problems)
+        release.set()
+        t.join(2.0)
+        assert rec.problems(join_timeout=0.5) == []
+
+
+def _run_script(source: str):
+    return subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(source)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "FLINK_ML_TPU_SANITIZE": "1"},
+        timeout=120,
+    )
+
+
+class TestInstrumentedFlowEndToEnd:
+    def test_clean_pump_drain_exits_zero(self):
+        result = _run_script(
+            """
+            from flink_ml_tpu.analysis import sanitizer
+            sanitizer.enable()
+            from flink_ml_tpu import flow
+
+            ch = flow.BoundedChannel(4, name="t.clean")
+            flow.pump(range(32), ch, transform=lambda x: x * 2)
+            assert list(ch) == [x * 2 for x in range(32)]
+            """
+        )
+        assert result.returncode == 0, result.stderr
+        assert "FLINK_ML_TPU_SANITIZE: clean" in result.stderr
+
+    def test_abandoned_pump_worker_fails_at_exit(self):
+        result = _run_script(
+            """
+            import itertools
+            from flink_ml_tpu.analysis import sanitizer
+            sanitizer.enable()
+            from flink_ml_tpu import flow
+
+            ch = flow.BoundedChannel(2, name="t.leak")
+            flow.pump(itertools.count(), ch)  # unbounded producer
+            ch.get()  # consume one, then abandon WITHOUT cancel/close
+            """
+        )
+        assert result.returncode == 66, result.stdout + result.stderr
+        assert "leaked worker" in result.stderr
+        assert "unclosed pump channel" in result.stderr
+
+    def test_cancel_releases_the_worker(self):
+        result = _run_script(
+            """
+            import itertools
+            from flink_ml_tpu.analysis import sanitizer
+            sanitizer.enable()
+            from flink_ml_tpu import flow
+
+            ch = flow.BoundedChannel(2, name="t.cancelled")
+            flow.pump(itertools.count(), ch)
+            ch.get()
+            ch.cancel()  # the consumer-side handshake
+            """
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "FLINK_ML_TPU_SANITIZE: clean" in result.stderr

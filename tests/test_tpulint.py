@@ -56,6 +56,8 @@ def test_cli_list_rules_catalogue():
         "upload-accounting",
         "fusion-coverage",
         "checkpoint-coverage",
+        "lock-order",
+        "channel-protocol",
         "unused-suppression",
     ):
         assert rule_id in result.stdout, rule_id
@@ -225,3 +227,202 @@ def test_changed_mode_reports_only_changed_files(tmp_path):
     result = _run_cli("--root", str(root), "--changed", "--rule", "retrace-hazard")
     assert result.returncode == 0, result.stdout + result.stderr
     assert "no files differ" in result.stdout
+
+
+# ---------------------------------------------------------------------------
+# tpulint v2: interprocedural taint (acceptance + recall-superset gates)
+# ---------------------------------------------------------------------------
+
+#: a device->host pull laundered through TWO helper layers — the shape the
+#: per-function v1 engine provably cannot see (every call laundered taint)
+TWO_LAYER_LAUNDER = """
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(x):
+    return np.asarray(x)
+
+
+def _helper(x):
+    return _to_host(x)
+
+
+def fit(X):
+    dev = jnp.sum(X, axis=0)
+    return _helper(dev)
+"""
+
+#: direct violations both engines must agree on (the recall baseline)
+DIRECT_VIOLATIONS = """
+import jax.numpy as jnp
+import numpy as np
+
+
+def fit(X):
+    dev = jnp.sum(X, axis=0)
+    a = np.asarray(dev)
+    b = dev.item()
+    c = float(dev)
+    return a, b, c
+"""
+
+
+def _hostsync_reports(tmp_path, files):
+    """(per-function v1 report, interprocedural v2 report) over the same
+    fixture tree, same rule class, only the `interprocedural` flag differs."""
+    import textwrap as _tw
+
+    from flink_ml_tpu.analysis import engine as _engine
+    from flink_ml_tpu.analysis.engine import Project
+
+    for rel, src in files.items():
+        path = tmp_path / "flink_ml_tpu" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(_tw.dedent(src))
+    rule_cls = type(_engine.get_rule("host-sync-leak"))
+    reports = []
+    for interprocedural in (False, True):
+        rule = rule_cls()
+        rule.interprocedural = interprocedural
+        project = Project.load(root=str(tmp_path), scope=("flink_ml_tpu",))
+        reports.append(
+            _engine.run(root=str(tmp_path), rules=[rule], project=project)
+        )
+    return reports
+
+
+def test_interprocedural_catches_two_layer_laundering(tmp_path):
+    """THE v2 acceptance case: np.asarray buried two helpers deep. The old
+    per-function engine provably misses it; the interprocedural engine
+    flags the top-level call site with the full chain."""
+    legacy, v2 = _hostsync_reports(
+        tmp_path,
+        {"models/bad.py": TWO_LAYER_LAUNDER, "models/__init__.py": "", "__init__.py": "",
+         "utils/__init__.py": "", "utils/lazyjit.py": "def lazy_jit(f, **k):\n    return f\n"},
+    )
+    assert legacy.findings == []  # v1 blind spot, demonstrated
+    assert len(v2.findings) == 1
+    f = v2.findings[0]
+    assert f.path == "flink_ml_tpu/models/bad.py"
+    assert f.line == 16  # `return _helper(dev)` in fit
+    assert f.data[0] == "np-pull-chain"
+    assert list(f.data[2:]) == ["_helper", "_to_host"]  # the full chain
+    assert "models/bad.py:7" in f.message  # the sink line
+
+
+def test_interprocedural_findings_superset_of_per_function(tmp_path):
+    """No recall regressions: on seeded fixtures mixing direct violations
+    with laundered ones, every v1 finding location survives in v2."""
+    legacy, v2 = _hostsync_reports(
+        tmp_path,
+        {
+            "models/direct.py": DIRECT_VIOLATIONS,
+            "models/laundered.py": TWO_LAYER_LAUNDER,
+            "models/__init__.py": "",
+            "__init__.py": "",
+            "utils/__init__.py": "",
+            "utils/lazyjit.py": "def lazy_jit(f, **k):\n    return f\n",
+        },
+    )
+    legacy_keys = {(f.path, f.line, f.data) for f in legacy.findings}
+    v2_keys = {(f.path, f.line, f.data) for f in v2.findings}
+    assert legacy_keys, "the baseline must find the direct violations"
+    assert legacy_keys <= v2_keys, legacy_keys - v2_keys
+    assert len(v2_keys) > len(legacy_keys)  # and v2 sees strictly more
+
+
+def test_repo_is_clean_under_interprocedural_pass_with_concurrency_rules():
+    """Tier-1 acceptance: the FULL v2 rule set — interprocedural
+    host-sync + donation plus the lock-order and channel-protocol
+    concurrency rules — runs over the real package and is clean."""
+    from flink_ml_tpu.analysis import engine
+
+    rule_ids = {r.id for r in engine.all_rules()}
+    assert {"lock-order", "channel-protocol"} <= rule_ids
+    assert engine.get_rule("host-sync-leak").interprocedural is True
+    assert engine.get_rule("donation-after-use").interprocedural is True
+    report = engine.run()  # every rule: subsets would orphan suppressions
+    assert report.findings == [], "\n".join(f.format() for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --format json, --changed robustness
+# ---------------------------------------------------------------------------
+
+def test_format_json_machine_readable(tmp_path):
+    import json
+
+    root = _seed_tree(
+        tmp_path,
+        "models/bad.py",
+        TWO_LAYER_LAUNDER,
+    )
+    result = _run_cli("--root", str(root), "--rule", "host-sync-leak", "--format", "json")
+    assert result.returncode == 1, result.stdout + result.stderr
+    payload = json.loads(result.stdout)
+    assert payload["clean"] is False
+    (finding,) = payload["findings"]
+    assert finding["file"] == "flink_ml_tpu/models/bad.py"
+    assert finding["line"] == 16
+    assert finding["rule"] == "host-sync-leak"
+    assert finding["chain"] == ["_helper", "_to_host"]
+
+
+def test_format_json_clean_tree(tmp_path):
+    import json
+
+    root = _seed_tree(tmp_path, "models/ok.py", "x = 1\n")
+    result = _run_cli("--root", str(root), "--rule", "host-sync-leak", "--format", "json")
+    assert result.returncode == 0, result.stdout + result.stderr
+    payload = json.loads(result.stdout)
+    assert payload["clean"] is True and payload["findings"] == []
+
+
+def test_changed_mode_survives_renames_and_deletes(tmp_path):
+    """--changed with a renamed file (old path exists only in HEAD) and a
+    deleted file must lint the NEW path and skip the gone ones."""
+    root = _seed_tree(tmp_path, "models/old_name.py", "x = 1\n")
+    (root / "flink_ml_tpu" / "models" / "doomed.py").write_text("y = 2\n")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GIT_AUTHOR_NAME": "t",
+        "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t",
+        "GIT_COMMITTER_EMAIL": "t@t",
+    }
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=str(root), check=True, capture_output=True, env=env
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    # rename + inject a violation into the renamed file; delete the other
+    old = root / "flink_ml_tpu" / "models" / "old_name.py"
+    new = root / "flink_ml_tpu" / "models" / "new_name.py"
+    old.rename(new)
+    new.write_text(
+        "import jax\n\ndef _impl(x):\n    return x\n\n_kernel = jax.jit(_impl)\n"
+    )
+    (root / "flink_ml_tpu" / "models" / "doomed.py").unlink()
+    result = _run_cli("--root", str(root), "--changed", "--rule", "retrace-hazard")
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "flink_ml_tpu/models/new_name.py:6" in result.stdout
+    assert "doomed" not in result.stdout
+    assert "old_name" not in result.stdout
+
+
+def test_changed_mode_outside_git_falls_back_to_full_lint(tmp_path):
+    root = _seed_tree(
+        tmp_path,
+        "models/bad.py",
+        "import jax\n\ndef _impl(x):\n    return x\n\n_kernel = jax.jit(_impl)\n",
+    )
+    result = _run_cli("--root", str(root), "--changed", "--rule", "retrace-hazard")
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "linting the whole tree" in result.stderr
+    assert "flink_ml_tpu/models/bad.py:6" in result.stdout

@@ -670,3 +670,583 @@ class TestEngine:
             only_paths=["flink_ml_tpu/models/a.py"],
         )
         assert [f.path for f in partial.findings] == ["flink_ml_tpu/models/a.py"]
+
+
+# ---------------------------------------------------------------------------
+# interprocedural host-sync-leak (the v2 call-graph rewiring)
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralHostSync:
+    def test_laundered_pull_flagged_at_call_site_with_chain(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def _to_host(x):
+                    return np.asarray(x)
+
+                def fit(X):
+                    dev = jnp.sum(X, axis=0)
+                    return _to_host(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.line == 10  # the call site in fit, not the helper
+        assert f.data[0] == "np-pull-chain"
+        assert "_to_host" in f.message
+        assert "models/bad.py:6" in f.message  # the sink's file:line
+
+    def test_cross_module_laundering(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/helpers.py": """
+                import numpy as np
+
+                def to_host(x):
+                    return np.asarray(x)
+            """,
+            "ops/__init__.py": "",
+            "models/bad.py": """
+                import jax.numpy as jnp
+
+                from ..ops.helpers import to_host
+
+                def fit(X):
+                    dev = jnp.mean(X)
+                    return to_host(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert [f.path for f in report.findings] == ["flink_ml_tpu/models/bad.py"]
+        assert report.findings[0].line == 8
+        assert "ops/helpers.py:5" in report.findings[0].message
+
+    def test_helper_returning_device_taints_caller(self, tmp_path):
+        """A resolved helper that RETURNS a device value un-launders the
+        old per-function blind spot: np.asarray on its result is flagged."""
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def _make(X):
+                    return jnp.sum(X, axis=0)
+
+                def fit(X):
+                    dev = _make(X)
+                    return np.asarray(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert len(report.findings) == 1
+        assert report.findings[0].line == 10
+        assert report.findings[0].data[0] == "np-pull"
+
+    def test_method_helper_resolved_through_self(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                class Model:
+                    def _pull(self, v):
+                        return np.asarray(v)
+
+                    def fit(self, X):
+                        dev = jnp.sum(X)
+                        return self._pull(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert len(report.findings) == 1
+        assert report.findings[0].line == 11
+        assert "Model._pull" in report.findings[0].message
+
+    def test_host_input_through_helper_is_clean(self, tmp_path):
+        """The under-approximation survives: callers passing HOST values
+        to a syncing helper are not flagged."""
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import numpy as np
+
+                def _to_host(x):
+                    return np.asarray(x)
+
+                def fit(rows):
+                    return _to_host(rows)  # rows is host data
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert report.findings == []
+
+    def test_suppressed_sink_in_helper_covers_callers(self, tmp_path):
+        """A suppression-with-reason ON the helper's sink line keeps the
+        site out of the summary (callers inherit no finding) while the
+        annotated helper still shows in the census."""
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                def _probe(x):
+                    # tpulint: disable=host-sync-leak -- deliberate: tiny scalar probe
+                    return np.asarray(x)
+
+                def fit(X):
+                    dev = jnp.sum(X)
+                    return _probe(dev)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["host-sync-leak"])
+        assert report.findings == []  # no caller finding, no unused-suppression
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].path == "flink_ml_tpu/models/good.py"
+
+
+# ---------------------------------------------------------------------------
+# interprocedural donation-after-use
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralDonation:
+    def test_wrapper_around_donating_kernel_poisons_caller(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+
+                def _impl(a, b):
+                    return a + b
+
+                _step_donating = jax.jit(_impl, donate_argnums=(0,))
+
+                def wrapper(carry, other):
+                    return _step_donating(carry, other)
+
+                def fit(carry, other):
+                    out = wrapper(carry, other)
+                    return out + carry
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.line == 14  # `return out + carry` in fit
+        assert "wrapper" in f.message and "_step_donating" in f.message
+
+    def test_wrapper_result_use_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax
+
+                def _impl(a, b):
+                    return a + b
+
+                _step_donating = jax.jit(_impl, donate_argnums=(0,))
+
+                def wrapper(carry, other):
+                    return _step_donating(carry, other)
+
+                def fit(carry, other):
+                    carry = wrapper(carry, other)  # ping-pong rebind
+                    return carry
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["donation-after-use"])
+        assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_true_positive_abba_inversion(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+
+                    def one(self):
+                        with self._a:
+                            with self._b:
+                                pass
+
+                    def two(self):
+                        with self._b:
+                            with self._a:
+                                pass
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data[0] == "cycle"
+        assert "S._a" in f.message and "S._b" in f.message
+
+    def test_true_negative_consistent_order(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+
+                    def one(self):
+                        with self._a:
+                            with self._b:
+                                pass
+
+                    def two(self):
+                        with self._a:
+                            with self._b:
+                                pass
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert report.findings == []
+
+    def test_self_deadlock_through_transitive_call(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._m = threading.Lock()
+
+                    def outer(self):
+                        with self._m:
+                            self.inner()
+
+                    def inner(self):
+                        with self._m:
+                            pass
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data[0] == "self-deadlock"
+        assert "S.inner" in report.findings[0].message
+
+    def test_reentrant_condition_self_nesting_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def outer(self):
+                        with self._cv:
+                            self.inner()
+
+                    def inner(self):
+                        with self._cv:
+                            pass
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert report.findings == []
+
+    def test_cross_module_cycle_via_imported_call(self, tmp_path):
+        report = _run(tmp_path, {
+            "data/devicecache.py": """
+                import threading
+
+                from ..serving import poke
+
+                _cache_lock = threading.Lock()
+
+                def refresh():
+                    with _cache_lock:
+                        poke()
+
+                def touch():
+                    with _cache_lock:
+                        pass
+            """,
+            "data/__init__.py": "",
+            "serving.py": """
+                import threading
+
+                _serve_lock = threading.Lock()
+
+                def poke():
+                    with _serve_lock:
+                        pass
+
+                def other():
+                    from .data.devicecache import touch
+                    with _serve_lock:
+                        touch()
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data[0] == "cycle"
+        assert "_cache_lock" in report.findings[0].message
+        assert "_serve_lock" in report.findings[0].message
+
+    def test_suppression_hides_cycle_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+
+                    def one(self):
+                        with self._a:
+                            # tpulint: disable=lock-order -- fixture: order proven safe by external protocol
+                            with self._b:
+                                pass
+
+                    def two(self):
+                        with self._b:
+                            with self._a:
+                                pass
+            """,
+            **LAZYJIT_STUB,
+        }, ["lock-order"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# channel-protocol
+# ---------------------------------------------------------------------------
+
+FLOW_STUB = {
+    "flow.py": """
+        import threading
+
+        class BoundedChannel:
+            def __init__(self, capacity, policy="block", name="channel"):
+                self._cv = threading.Condition()
+                self.name = name
+            def put(self, item, timeout=None):
+                return True
+            def get(self, timeout=None):
+                return None
+            def close(self, error=None):
+                pass
+            def cancel(self):
+                return []
+            def __iter__(self):
+                return iter(())
+
+        def pump(items, channel, transform=None, watchdog=None):
+            pass
+
+        def spawn(fn, name="worker"):
+            pass
+    """,
+}
+
+
+class TestChannelProtocol:
+    def test_worker_never_closing_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                class Server:
+                    def start(self):
+                        self._out = flow.BoundedChannel(4, name="out")
+                        self._worker = flow.spawn(self._run, name="d")
+
+                    def _run(self):
+                        while True:
+                            self._out.put(1)
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data == ("worker", "Server._run")
+        assert "never closes" in f.message
+
+    def test_worker_without_error_path_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                class Server:
+                    def start(self):
+                        self._out = flow.BoundedChannel(4, name="out")
+                        self._worker = flow.spawn(self._run, name="d")
+
+                    def _run(self):
+                        for item in (1, 2, 3):
+                            self._out.put(item)
+                        self._out.close()
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert len(report.findings) == 1
+        assert "happy path" in report.findings[0].message
+
+    def test_close_with_error_worker_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                class Server:
+                    def start(self):
+                        self._out = flow.BoundedChannel(4, name="out")
+                        self._worker = flow.spawn(self._run, name="d")
+
+                    def _run(self):
+                        try:
+                            for item in (1, 2, 3):
+                                self._out.put(item)
+                            self._out.close()
+                        except BaseException as e:
+                            self._out.close(error=e)
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert report.findings == []
+
+    def test_worker_closing_via_helper_in_finally_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                class Server:
+                    def start(self):
+                        self._out = flow.BoundedChannel(4, name="out")
+                        self._worker = flow.spawn(self._run, name="d")
+
+                    def _release(self):
+                        self._out.cancel()
+
+                    def _run(self):
+                        try:
+                            self._out.put(1)
+                        finally:
+                            self._release()
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert report.findings == []
+
+    def test_undrained_channel_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                def leak():
+                    ch = flow.BoundedChannel(2, name="x")
+                    ch.put(1)
+                    ch.put(2)
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("undrained-channel", "ch")
+
+    def test_pumped_iterated_cancelled_channel_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                def prefetch(items, stage):
+                    ch = flow.BoundedChannel(4, name="p")
+                    flow.pump(items, ch, transform=stage)
+                    try:
+                        yield from ch
+                    finally:
+                        ch.cancel()
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert report.findings == []
+
+    def test_channel_closed_by_resolved_helper_is_clean(self, tmp_path):
+        """param_closes: handing the channel to a helper that cancels it
+        satisfies the contract through the call graph."""
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                def _teardown(window):
+                    window.cancel()
+
+                def serve(stream):
+                    window = flow.BoundedChannel(4, name="w")
+                    for item in stream:
+                        window.put(item)
+                    _teardown(window)
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert report.findings == []
+
+    def test_submit_without_results_is_flagged(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/client.py": """
+                def run(server, batches):
+                    for b in batches:
+                        server.submit(b)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["channel-protocol"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("submit-without-results",)
+
+    def test_submit_with_results_loop_is_clean(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/client.py": """
+                def run(server, batches):
+                    for b in batches:
+                        server.submit(b)
+                    server.close()
+                    return list(server.results())
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["channel-protocol"])
+        assert report.findings == []
+
+    def test_suppression_hides_undrained_channel(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving.py": """
+                from . import flow
+
+                def leak():
+                    # tpulint: disable=channel-protocol -- fixture: drained by the caller via attribute
+                    ch = flow.BoundedChannel(2, name="x")
+                    ch.put(1)
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+        }, ["channel-protocol"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
