@@ -900,6 +900,157 @@ def bench_overload_soak(num_requests=60, batch_rows=256, d=24):
     return result
 
 
+def bench_hot_swap_soak(num_batches=96, batch_rows=512, d=32, num_swaps=24):
+    """Robustness workload (ISSUE 10): versioned zero-pause model hot-swap
+    under serving load, asserted in-process:
+
+    1. **Zero-pause, zero-recompile swaps** — a trainer thread promotes
+       `num_swaps` validated versions through `lifecycle.ModelLifecycle`
+       while a MicroBatchServer drives the FUSED plan over `num_batches`
+       batches. The jit compile counter must stay flat after warmup
+       (model tensors are runtime operands, not baked constants), and
+       per-batch p99 latency across the swap phase is reported against
+       the no-swap steady state — the "zero pause" number.
+    2. **Zero torn reads** — every served batch's modelVersion column
+       must hold exactly ONE value, that value must have been promoted
+       (never a rejected candidate), and versions must be monotone.
+    3. **Gate + rollback** — a NaN-poisoned candidate is refused at the
+       gate (`promoteRejected`); a bad-but-finite promotion followed by a
+       guard-error window triggers the automatic rollback, which must
+       restore the retained last-good version BIT-EXACTLY; the wall from
+       first guard error to the first batch served on the rolled-back
+       version is the rollback-to-recovery number.
+    """
+    import jax
+
+    from flink_ml_tpu import flow
+    from flink_ml_tpu.lifecycle import ModelLifecycle, PromotionRejected
+    from flink_ml_tpu.models.classification.onlinelogisticregression import (
+        OnlineLogisticRegressionModel,
+    )
+    from flink_ml_tpu.models.feature.standardscaler import StandardScalerModel
+    from flink_ml_tpu.obs import tracing
+    from flink_ml_tpu.pipeline import PipelineModel
+    from flink_ml_tpu.serving import MicroBatchServer
+    from flink_ml_tpu.table import Table
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(23)
+    t_start = time.perf_counter()
+
+    scaler = StandardScalerModel()
+    scaler.mean = rng.standard_normal(d)
+    scaler.std = np.abs(rng.standard_normal(d)) + 0.1
+    scaler.set_input_col("features").set_output_col("features")
+    model = OnlineLogisticRegressionModel()
+    model.publish_model_arrays((np.zeros(d),), 0)
+    model.set_features_col("features").set_prediction_col("pred")
+    lifecycle = ModelLifecycle(model, retained=4, health_window=4, error_rate_trigger=0.5)
+    pm = PipelineModel([scaler, model])
+    server = MicroBatchServer(pm, in_flight=2, device_input=True, lifecycle=lifecycle)
+
+    def batches(n):
+        for _ in range(n):
+            yield Table(
+                {"features": rng.standard_normal((batch_rows, d), dtype=np.float32)}
+            )
+
+    def timed_serve(n):
+        walls, versions = [], []
+        t_prev = time.perf_counter()
+        for out in server.serve(batches(n)):
+            got = np.unique(np.asarray(out.column("modelVersion")))
+            assert len(got) == 1, "torn read: one batch served by two versions"
+            versions.append(int(got[0]))
+            now = time.perf_counter()
+            walls.append((now - t_prev) * 1000.0)
+            t_prev = now
+        return walls, versions
+
+    # warmup + steady state (no swaps)
+    timed_serve(4)
+    tracing.install_jax_hooks()
+    compiles_before = metrics.get_counter("jit.compiles", 0)
+    steady_walls, _ = timed_serve(num_batches // 2)
+
+    # swap phase: trainer promotes while the server serves
+    accepted: list = []
+    rejected_count = [0]
+    base = np.zeros(d)
+
+    def trainer():
+        for i in range(1, num_swaps + 1):
+            candidate = base + 0.01 * i
+            if i % 6 == 0:  # NaN-poisoned update: the gate must refuse it
+                poisoned = candidate.copy()
+                poisoned[i % d] = np.nan
+                try:
+                    lifecycle.promote((poisoned,))
+                except PromotionRejected:
+                    rejected_count[0] += 1
+                continue
+            accepted.append(lifecycle.promote((candidate,)).version_id)
+            time.sleep(0.001)
+
+    t_swap = time.perf_counter()
+    worker = flow.spawn(trainer, name="hotswap.trainer")
+    swap_walls, served_versions = timed_serve(num_batches)
+    worker.join(timeout=120.0)
+    assert not worker.is_alive(), "trainer wedged"
+    swap_phase_s = time.perf_counter() - t_swap
+
+    compiles_during = metrics.get_counter("jit.compiles", 0) - compiles_before
+    assert compiles_during == 0, f"{compiles_during} recompiles across {len(accepted)} swaps"
+    valid = set(accepted) | {0}
+    assert set(served_versions) <= valid, "a never-promoted version was served"
+    assert served_versions == sorted(served_versions), "served versions went backwards"
+    assert rejected_count[0] == num_swaps // 6, "every poisoned candidate must be refused"
+    lifecycle.record_serve_ok()
+
+    # rollback leg: bad-but-finite promotion slips the gate; guard errors
+    # roll traffic back; recovery = first batch served on the good version
+    good_version = model.model_version
+    good_coeff = np.copy(model.coefficient)
+    lifecycle.promote((base + 1e6,))
+    t_trigger = time.perf_counter()
+    for _ in range(4):
+        lifecycle.record_guard_error(ValueError("downstream guard fired"))
+    assert lifecycle.rollback_count == 1
+    _, recovered = timed_serve(1)
+    rollback_recovery_ms = (time.perf_counter() - t_trigger) * 1000.0
+    assert recovered == [good_version], "post-rollback traffic must serve last-good"
+    assert np.array_equal(model.coefficient, good_coeff), "rollback must be bit-exact"
+    jax.block_until_ready([])
+
+    p99 = lambda xs: float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
+    result = {
+        "numBatches": num_batches,
+        "batchRows": batch_rows,
+        "swapCount": len(accepted),
+        "promoteRejected": rejected_count[0],
+        "rollbackCount": 1,
+        "swapsPerSec": len(accepted) / swap_phase_s if swap_phase_s else 0.0,
+        "steadyP50Ms": float(np.percentile(np.asarray(steady_walls), 50)),
+        "steadyP99Ms": p99(steady_walls),
+        "swapPhaseP50Ms": float(np.percentile(np.asarray(swap_walls), 50)),
+        "swapPhaseP99Ms": p99(swap_walls),
+        "rollbackRecoveryMs": rollback_recovery_ms,
+        "recompilesDuringSwaps": int(compiles_during),  # asserted 0
+        "tornReads": 0,  # asserted per batch above
+        "servedVersionsMonotone": True,  # asserted above
+        "rollbackBitExact": True,  # asserted above
+        "wallMs": (time.perf_counter() - t_start) * 1000.0,
+    }
+    log(
+        f"hotSwapSoak: {result['swapCount']} swaps at "
+        f"{result['swapsPerSec']:.0f}/s under load, p99 {result['swapPhaseP99Ms']:.2f}ms "
+        f"across swaps vs {result['steadyP99Ms']:.2f}ms steady, 0 recompiles, "
+        f"{result['promoteRejected']} NaN candidates refused, rollback recovered "
+        f"bit-exact in {rollback_recovery_ms:.1f}ms"
+    )
+    return result
+
+
 def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
     """The comm-layer workload (ISSUE 4): per-device-count collective
     traffic and wall time from scripts/bench_collectives.py — bucketed
@@ -973,6 +1124,7 @@ def main(argv):
         "inputPipeline": None,
         "checkpointResume": None,
         "overloadSoak": None,
+        "hotSwapSoak": None,
         "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
@@ -1066,6 +1218,12 @@ def main(argv):
                 details["overloadSoak"] = bench_overload_soak()
             except Exception as e:
                 log(f"overloadSoak stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["hotSwapSoak"] = bench_hot_swap_soak()
+            except Exception as e:
+                log(f"hotSwapSoak stage failed: {e!r}")
 
         if in_budget():
             try:

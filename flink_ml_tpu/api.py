@@ -55,6 +55,7 @@ class Stage(WithParams, abc.ABC):
         from .obs.tracing import instrument_stage_methods
 
         instrument_stage_methods(cls)
+        _instrument_model_publication(cls)
 
     # Data-placement hint for loaders/generators: True when the stage's hot
     # path is inherently host-resident (e.g. categorical string rendering),
@@ -83,6 +84,34 @@ class Stage(WithParams, abc.ABC):
 
     def _load_extra(self, path: str) -> None:
         """Hook for subclasses to restore model data from `{path}/data`."""
+
+
+def _instrument_model_publication(cls) -> None:
+    """Route every concrete `set_model_data` through an explicit
+    constants-cache invalidation. The device-constant memo and the fusion
+    plan cache key on array OBJECT IDENTITY, which is sound for the
+    re-assign-never-mutate idiom — but `id()` values are reused after GC,
+    and `set_model_data` replaces model arrays outside the params path, so
+    a swapped model could in principle serve a stale cached upload. The
+    wrapper bumps the monotone `model_data_version` (consumed by
+    `device_constants` and the plan token) after every publication, making
+    invalidation explicit instead of identity-coincidental."""
+    fn = cls.__dict__.get("set_model_data")
+    if fn is None or not callable(fn) or getattr(fn, "_publish_instrumented", False):
+        return
+
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(self, *inputs):
+        result = fn(self, *inputs)
+        bump = getattr(self, "bump_model_data_version", None)
+        if bump is not None:
+            bump()
+        return result
+
+    wrapped._publish_instrumented = True
+    cls.set_model_data = wrapped
 
 
 class AlgoOperator(Stage):
@@ -178,15 +207,31 @@ class AlgoOperator(Stage):
         """Raw arrays whose object identity versions the constant cache."""
         return ()
 
+    @property
+    def model_data_version(self) -> int:
+        """Monotone publication counter: bumped by every `set_model_data`
+        (auto-routed via `_instrument_model_publication`) and by the
+        versioned-publication paths of swap-capable models. Belt to the
+        identity braces of `_constant_sources()` — `id()` reuse after GC
+        can never serve a stale cached upload past an explicit bump."""
+        return self.__dict__.get("_model_data_version", 0)
+
+    def bump_model_data_version(self) -> None:
+        """Explicit constants-cache invalidation for a model-data change."""
+        self.__dict__["_model_data_version"] = self.model_data_version + 1
+        self.__dict__.pop("_device_consts", None)
+
     def device_constants(self):
         """Device-resident `_kernel_constants()`, uploaded at most once per
         (model arrays, params) state. Model arrays are re-assigned (never
         mutated in place) across this codebase, so object identity of the
-        `_constant_sources()` plus the params version is a sound cache key."""
+        `_constant_sources()` plus the params version — plus the explicit
+        `model_data_version` publication counter — is a sound cache key."""
         import jax
 
         token = (
             self.__dict__.get("_params_version", 0),
+            self.model_data_version,
             tuple(id(a) for a in self._constant_sources()),
         )
         cached = self.__dict__.get("_device_consts")
@@ -205,13 +250,45 @@ class Transformer(AlgoOperator):
 
 
 class Model(Transformer):
-    """A Transformer with explicit model data tables (Model.java:31-50)."""
+    """A Transformer with explicit model data tables (Model.java:31-50).
+
+    Hot-swap protocol (lifecycle.py): a model whose serving arrays may be
+    replaced while a compiled plan is live sets `swap_capable = True` and
+    implements the three hooks below. The fusion planner then feeds the
+    model's tensors as *versioned runtime operands* — the plan cache key
+    drops their identities, the jitted segment re-reads the published
+    buffers per dispatch, and `publish_model_arrays` becomes a zero-pause,
+    zero-recompile pointer swap between batches. Publication MUST be one
+    atomic reference assignment of an immutable (version, arrays) record:
+    a reader holding the old reference keeps a consistent old model — no
+    torn (new arrays, old version) state can ever be observed."""
+
+    # True: model tensors ride the fused path as swappable runtime operands
+    swap_capable: bool = False
 
     def set_model_data(self, *inputs: Table) -> "Model":
         raise NotImplementedError(f"{type(self).__name__} does not support set_model_data")
 
     def get_model_data(self) -> List[Table]:
         raise NotImplementedError(f"{type(self).__name__} does not support get_model_data")
+
+    # -- swap-capable hooks (lifecycle.ModelLifecycle drives these) ----------
+    def model_arrays(self) -> tuple:
+        """The currently PUBLISHED serving arrays as one consistent tuple
+        (read from a single atomic record — never field by field)."""
+        raise NotImplementedError(f"{type(self).__name__} is not swap-capable")
+
+    def publish_model_arrays(self, arrays: tuple, version: int) -> None:
+        """Atomically publish `(version, arrays)` as the serving model —
+        the reference's `set_model_data` + modelDataVersion bump, reborn
+        as a single reference swap."""
+        raise NotImplementedError(f"{type(self).__name__} is not swap-capable")
+
+    def kernel_constants_for(self, arrays: tuple, version: int = 0):
+        """`_kernel_constants()` computed from an ARBITRARY candidate
+        arrays tuple (not the published one) — the promotion gate runs
+        canary batches against candidates without publishing them."""
+        raise NotImplementedError(f"{type(self).__name__} is not swap-capable")
 
 
 class Estimator(Stage):

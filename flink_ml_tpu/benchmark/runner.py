@@ -177,6 +177,14 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "shedCount": int(delta["counters"].get("flow.shed", 0)),
         "rejectCount": int(delta["counters"].get("flow.reject", 0)),
         "peakQueueDepth": int(delta["gauges"].get("flow.peakQueueDepth", 0)),
+        # model-lifecycle evidence (lifecycle.py): live model versions this
+        # entry published into a serving plan, promotions the gate refused,
+        # and health-triggered rollbacks — a promoteRejected jump between
+        # BENCH files means the trainer started producing bad candidates,
+        # a rollbackCount jump means bad ones started slipping the gate
+        "swapCount": int(delta["counters"].get("lifecycle.swap", 0)),
+        "rollbackCount": int(delta["counters"].get("lifecycle.rollback", 0)),
+        "promoteRejected": int(delta["counters"].get("lifecycle.promoteRejected", 0)),
         # per-op collective traffic this entry traced (calls/bytes/chunks
         # from the accounted wrappers in parallel/collectives.py, plus the
         # sparse-vs-dense byte ratio when a sparse reduce ran) — the

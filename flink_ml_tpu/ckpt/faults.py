@@ -33,6 +33,12 @@ out of the loop at a controlled point. Two entry styles:
   | `datacache.read`  | INSIDE `DataCache.read_array` — a spill-file read |
   | `datacache.append`| INSIDE `DataCache.append_array` — a spill write   |
   | `serving.batch`   | INSIDE `MicroBatchServer`'s batch dispatch        |
+  | `lifecycle.promote`| AT `ModelLifecycle.promote` entry — a trainer     |
+  |                   | kill before anything durable happened             |
+  | `lifecycle.swap`  | INSIDE `promote`, after the snapshot write but    |
+  |                   | BEFORE the pointer swap — the mid-publish kill    |
+  |                   | the resume-republishes-same-version contract      |
+  |                   | covers (docs/model_lifecycle.md)                  |
 
   Ticks fire AFTER the boundary's snapshot save, so an injected kill
   models a crash between a completed checkpoint and the next boundary —

@@ -255,6 +255,46 @@ if os.environ.get("FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY") in (
     online_overload_policy = os.environ["FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY"]
 
 
+# --- model lifecycle: hot-swap, promotion gate, rollback (lifecycle.py) -------
+# Promoted model versions retained in the lifecycle ring (host copies):
+# rollback targets live here, so a bad promotion can be rolled back to the
+# last-good version bit-exactly without restarting the server. Must be
+# >= 2 (current + at least one rollback target).
+model_versions_retained: int = 4
+# Relative tolerance of the promotion gate's optional canary-batch parity
+# check: the candidate's canary outputs must stay within this of the
+# OUTGOING version's outputs, or the promotion is refused
+# (`lifecycle.promoteRejected`). Generous by default — a healthy online
+# step moves predictions a little; a diverged trainer moves them a lot.
+lifecycle_canary_rtol: float = 0.5
+# Sliding health window (per-serve-batch outcomes) feeding the automatic
+# rollback trigger, and the guard-error rate over that window that fires
+# it: at >= the trigger rate over a FULL window, traffic rolls back to the
+# last-good version and the trainer's output is quarantined.
+lifecycle_health_window: int = 16
+lifecycle_error_rate_trigger: float = 0.5
+
+
+@contextmanager
+def model_retention_mode(retained: int):
+    """Scoped override of `model_versions_retained`."""
+    global model_versions_retained
+    prev = model_versions_retained
+    model_versions_retained = max(2, int(retained))
+    try:
+        yield
+    finally:
+        model_versions_retained = prev
+
+
+if os.environ.get("FLINK_ML_TPU_MODEL_VERSIONS_RETAINED"):
+    model_versions_retained = max(
+        2, int(os.environ["FLINK_ML_TPU_MODEL_VERSIONS_RETAINED"])
+    )
+if os.environ.get("FLINK_ML_TPU_LIFECYCLE_CANARY_RTOL"):
+    lifecycle_canary_rtol = float(os.environ["FLINK_ML_TPU_LIFECYCLE_CANARY_RTOL"])
+
+
 # --- persistent XLA compilation cache ----------------------------------------
 # Cold-start killer: compiled executables survive process restarts, so the
 # first fit of a new process reuses the previous process's XLA programs

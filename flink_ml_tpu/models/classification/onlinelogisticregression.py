@@ -13,13 +13,13 @@ host-driven unbounded loop.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...api import Estimator, Model
+from ...api import Estimator, KernelContext, Model, as_kernel_matrix
 from ...common.param import (
     HasBatchStrategy,
     HasElasticNet,
@@ -92,21 +92,85 @@ def _ftrl_step(coeff, z, n, X, y, alpha, beta, l1, l2):
     return new_coeff, z, n
 
 
+def _serve_scores(coeff, version, X):
+    """The serving computation shared by the fused transform kernel and the
+    eager device path (jitted once through `_jit_serve`): sigmoid scores,
+    hard prediction, two-class raw scores and the per-row model-version
+    stamp — all from ONE (coefficient, version) operand pair, so every row
+    of a batch is scored by exactly one model version."""
+    dot = X @ coeff
+    prob = 1.0 / (1.0 + jnp.exp(-dot))
+    pred = jnp.where(dot >= 0, 1.0, 0.0)
+    raw = jnp.stack([1.0 - prob, prob], axis=1)
+    vercol = jnp.full(X.shape[0], version, dtype=jnp.int32)
+    return pred, raw, vercol
+
+
+_jit_serve = lazy_jit(_serve_scores)
+
+
+class _PublishedLR(NamedTuple):
+    """One immutable published model version — the single-reference
+    publication record (see `_PublishedKMeans`): swapping it is atomic,
+    and a reader's snapshot is always a consistent (version, coefficient)
+    pair."""
+
+    version: int
+    coefficient: Optional[np.ndarray]
+
+
 class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
-    fusable = False
-    fusable_reason = "streaming model: serves the latest mutable host snapshot and stamps modelDataVersion per call; baking it into a compiled plan would freeze a stale model"
+    """Serves through the FUSED pipeline path with the coefficient vector
+    as a versioned runtime operand: a live `set_model_data`/
+    `publish_model_arrays` is a zero-pause, zero-recompile pointer swap
+    between batches, and the `modelVersionCol` output stamps every served
+    row with the exact version that scored it (the reference's
+    modelDataVersion contract — docs/model_lifecycle.md)."""
+    fusable = True
+    swap_capable = True
 
     def __init__(self):
-        self.coefficient: np.ndarray = None
-        self.model_version: int = 0
+        self._published = _PublishedLR(0, None)
         self._updates: Optional[Iterator] = None
+
+    @property
+    def coefficient(self) -> Optional[np.ndarray]:
+        return self._published.coefficient
+
+    @coefficient.setter
+    def coefficient(self, value) -> None:
+        self._publish(value, self._published.version)
+
+    @property
+    def model_version(self) -> int:
+        return self._published.version
+
+    @model_version.setter
+    def model_version(self, value: int) -> None:
+        self._publish(self._published.coefficient, int(value))
+
+    def _publish(self, coefficient, version: int) -> None:
+        coefficient = (
+            None if coefficient is None else np.asarray(coefficient, dtype=np.float64)
+        )
+        self._published = _PublishedLR(int(version), coefficient)
+        self.bump_model_data_version()
+
+    def model_arrays(self) -> tuple:
+        return (self._published.coefficient,)
+
+    def publish_model_arrays(self, arrays: tuple, version: int) -> None:
+        (coefficient,) = arrays
+        self._publish(coefficient, version)
 
     def set_model_data(self, *inputs) -> "OnlineLogisticRegressionModel":
         if len(inputs) == 1 and isinstance(inputs[0], Table):
             row = inputs[0].collect()[0]
-            self.coefficient = np.asarray(row["coefficient"].to_array(), dtype=np.float64)
+            coefficient = np.asarray(row["coefficient"].to_array(), dtype=np.float64)
+            version = self._published.version
             if "modelVersion" in inputs[0].column_names:
-                self.model_version = int(row["modelVersion"])
+                version = int(row["modelVersion"])
+            self._publish(coefficient, version)
             return self
         (stream,) = inputs
         self._updates = iter(stream)
@@ -134,18 +198,75 @@ class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
             return self.model_version
         processed = 0
         for version, coeff in self._updates:
-            self.coefficient = np.asarray(coeff, dtype=np.float64)
-            self.model_version = version
+            # ONE atomic publication per training batch (no torn
+            # coefficient-without-version state for a concurrent reader)
+            self._publish(coeff, version)
             metrics.set_gauge("OnlineLogisticRegressionModel.modelDataVersion", version)
             processed += 1
             if max_batches is not None and processed >= max_batches:
                 break
         return self.model_version
 
+    # -- fused transform kernel (versioned runtime operands) -----------------
+    def _kernel_constants(self) -> Dict[str, Any]:
+        pub = self._published  # ONE record read: consts are version-consistent
+        return self.kernel_constants_for((pub.coefficient,), pub.version)
+
+    def kernel_constants_for(self, arrays: tuple, version: int = 0) -> Dict[str, Any]:
+        (coefficient,) = arrays
+        return {
+            # f32 mirrors the device column dtype of the serving path
+            "coefficient": np.asarray(coefficient, dtype=np.float32),
+            "version": np.int32(version),
+        }
+
+    def _constant_sources(self) -> tuple:
+        return (self._published.coefficient,)
+
+    def kernel_output_cols(self) -> List[str]:
+        return [
+            self.get_prediction_col(),
+            self.get_raw_prediction_col(),
+            self.get_model_version_col(),
+        ]
+
+    def kernel_ready(self, cols: Dict[str, Any]) -> bool:
+        return self._published.coefficient is not None
+
+    def transform_kernel(self, consts, cols: Dict[str, Any], ctx: KernelContext) -> Dict[str, Any]:
+        X = as_kernel_matrix(cols[self.get_features_col()]).astype(jnp.float32)
+        pred, raw, vercol = _serve_scores(consts["coefficient"], consts["version"], X)
+        cols[self.get_prediction_col()] = pred
+        cols[self.get_raw_prediction_col()] = raw
+        cols[self.get_model_version_col()] = vercol
+        return cols
+
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
-        X = as_dense_matrix(table.column(self.get_features_col()))
-        dot = X @ self.coefficient
+        col = table.column(self.get_features_col())
+        if isinstance(col, jax.Array):
+            # device input: the SAME jitted computation the fused kernel
+            # runs (bit-parity with the fused path), consts from the same
+            # published-version snapshot, outputs pulled in ONE packed
+            # readback
+            from ...utils.packing import packed_device_get
+
+            consts = self.device_constants()
+            X = as_kernel_matrix(col).astype(jnp.float32)
+            out = _jit_serve(consts["coefficient"], consts["version"], X)
+            pred, raw, vercol = packed_device_get(*out, sync_kind="transform")
+            return [
+                table.with_columns(
+                    {
+                        self.get_prediction_col(): pred,
+                        self.get_raw_prediction_col(): raw,
+                        self.get_model_version_col(): vercol,
+                    }
+                )
+            ]
+        pub = self._published  # one record read: a consistent (version, coeff)
+        X = as_dense_matrix(col)
+        dot = X @ pub.coefficient
         prob = 1.0 / (1.0 + np.exp(-dot))
         pred = np.where(dot >= 0, 1.0, 0.0)
         raw = np.stack([1.0 - prob, prob], axis=1)
@@ -155,7 +276,7 @@ class OnlineLogisticRegressionModel(Model, OnlineLogisticRegressionModelParams):
                     self.get_prediction_col(): pred,
                     self.get_raw_prediction_col(): raw,
                     self.get_model_version_col(): np.full(
-                        X.shape[0], self.model_version, dtype=np.int64
+                        X.shape[0], pub.version, dtype=np.int64
                     ),
                 }
             )

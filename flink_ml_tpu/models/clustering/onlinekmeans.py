@@ -14,13 +14,13 @@ publishes a new model version (the reference's modelDataVersion gauge).
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...api import Estimator, Model
+from ...api import Estimator, KernelContext, Model, as_kernel_matrix
 from ...common.param import (
     HasBatchStrategy,
     HasDecayFactor,
@@ -94,22 +94,84 @@ def _batch_update(centroids, weights, X, decay, measure_name):
     return new_centroids, decayed + counts
 
 
+class _PublishedKMeans(NamedTuple):
+    """One immutable published model version. The ONLY mutable serving
+    state of `OnlineKMeansModel` is the single `_published` reference to
+    an instance of this — publication is one atomic assignment, so a
+    reader (serve thread) that grabbed the reference keeps a consistent
+    (version, centroids, weights) triple no matter how many swaps the
+    trainer thread lands meanwhile. Torn (new centroids, old weights)
+    states are unrepresentable."""
+
+    version: int
+    centroids: Optional[np.ndarray]
+    weights: Optional[np.ndarray]
+
+
 class OnlineKMeansModel(Model, KMeansModelParams):
     """Serves predictions from the latest model version
     (OnlineKMeansModel.java; `model_version` mirrors the modelDataVersion
-    gauge)."""
-    fusable = False
-    fusable_reason = "streaming model: serves the latest mutable centroid snapshot (modelDataVersion semantics); baking it into a compiled plan would freeze a stale model"
+    gauge). Serves through the FUSED pipeline path: the centroid tensor is
+    a versioned runtime operand of the compiled plan (not a baked
+    constant), so a live `set_model_data`/`publish_model_arrays` is a
+    zero-pause, zero-recompile pointer swap between batches — the
+    reference's modelDataVersion publication contract on device
+    (docs/model_lifecycle.md)."""
+    fusable = True
+    swap_capable = True
 
     def __init__(self):
-        self.centroids: np.ndarray = None
-        self.weights: np.ndarray = None
-        self.model_version: int = 0
+        self._published = _PublishedKMeans(0, None, None)
         self._updates: Optional[Iterator] = None
+
+    # -- atomic publication --------------------------------------------------
+    # centroids/weights/model_version stay as attributes for API compat,
+    # but all three read/write the ONE `_published` record.
+    @property
+    def centroids(self) -> Optional[np.ndarray]:
+        return self._published.centroids
+
+    @centroids.setter
+    def centroids(self, value) -> None:
+        pub = self._published
+        self._publish(value, pub.weights, pub.version)
+
+    @property
+    def weights(self) -> Optional[np.ndarray]:
+        return self._published.weights
+
+    @weights.setter
+    def weights(self, value) -> None:
+        pub = self._published
+        self._publish(pub.centroids, value, pub.version)
+
+    @property
+    def model_version(self) -> int:
+        return self._published.version
+
+    @model_version.setter
+    def model_version(self, value: int) -> None:
+        pub = self._published
+        self._publish(pub.centroids, pub.weights, int(value))
+
+    def _publish(self, centroids, weights, version: int) -> None:
+        centroids = None if centroids is None else np.asarray(centroids, dtype=np.float64)
+        weights = None if weights is None else np.asarray(weights, dtype=np.float64)
+        self._published = _PublishedKMeans(int(version), centroids, weights)
+        self.bump_model_data_version()
+
+    def model_arrays(self) -> tuple:
+        pub = self._published
+        return (pub.centroids, pub.weights)
+
+    def publish_model_arrays(self, arrays: tuple, version: int) -> None:
+        centroids, weights = arrays
+        self._publish(centroids, weights, version)
 
     def set_model_data(self, *inputs) -> "OnlineKMeansModel":
         if len(inputs) == 1 and isinstance(inputs[0], Table):
-            self.centroids, self.weights = _extract_model_data(inputs[0])
+            centroids, weights = _extract_model_data(inputs[0])
+            self._publish(centroids, weights, self._published.version)
             return self
         (stream,) = inputs
         self._updates = iter(stream)
@@ -138,14 +200,39 @@ class OnlineKMeansModel(Model, KMeansModelParams):
             return self.model_version
         processed = 0
         for version, (centroids, weights) in self._updates:
-            self.centroids = np.asarray(centroids, dtype=np.float64)
-            self.weights = np.asarray(weights, dtype=np.float64)
-            self.model_version = version
+            # ONE atomic publication per training batch — a concurrent
+            # serve thread sees either the old or the new (version,
+            # centroids, weights) triple, never a mixture
+            self._publish(centroids, weights, version)
             metrics.set_gauge("OnlineKMeansModel.modelDataVersion", version)
             processed += 1
             if max_batches is not None and processed >= max_batches:
                 break
         return self.model_version
+
+    # -- fused transform kernel (versioned runtime operand) ------------------
+    def _kernel_constants(self) -> Dict[str, Any]:
+        pub = self._published  # ONE record read: consts are version-consistent
+        return self.kernel_constants_for((pub.centroids, pub.weights), pub.version)
+
+    def kernel_constants_for(self, arrays: tuple, version: int = 0) -> Dict[str, Any]:
+        centroids, _ = arrays
+        # f32 cast mirrors the eager serve path (jnp.asarray(..., float32))
+        return {"centroids": np.asarray(centroids, dtype=np.float32)}
+
+    def _constant_sources(self) -> tuple:
+        pub = self._published
+        return (pub.centroids, pub.weights)
+
+    def kernel_ready(self, cols: Dict[str, Any]) -> bool:
+        return self._published.centroids is not None
+
+    def transform_kernel(self, consts, cols: Dict[str, Any], ctx: KernelContext) -> Dict[str, Any]:
+        X = as_kernel_matrix(cols[self.get_features_col()])
+        measure = DistanceMeasure.get_instance(self.get_distance_measure())
+        assign = measure.find_closest(X.astype(jnp.float32), consts["centroids"])
+        cols[self.get_prediction_col()] = assign.astype(jnp.int32)
+        return cols
 
     def transform(self, *inputs: Table) -> List[Table]:
         from ... import config

@@ -151,10 +151,15 @@ class FusedSegment:
 
             # tpulint: disable=retrace-hazard -- one compile per fused segment; plans are cached keyed on stage ids + params + model-array identities
             self._jit = jax.jit(self._run)
-            # stable for this plan's lifetime: a constant/param change
-            # invalidates the whole plan (PipelineModel._fusion_plan token)
-            self._consts_list = [stage.device_constants() for stage in self.stages]
-        out_cols, guard_vec = self._jit(self._consts_list, feed)
+        # model constants are RUNTIME OPERANDS of the jitted program, not
+        # baked trace constants: fetched per dispatch (memoized uploads —
+        # `device_constants` re-uploads only after a publication bump), so
+        # a swap-capable stage's live `set_model_data` reaches the next
+        # batch with zero recompiles. Each stage's consts are read ONCE
+        # here — the batch in flight keeps exactly the version it was
+        # dispatched with, however many swaps land during its compute.
+        consts_list = [stage.device_constants() for stage in self.stages]
+        out_cols, guard_vec = self._jit(consts_list, feed)
         if self._guard_messages:
             pending.append((tuple(self._guard_messages), guard_vec))
         return table.with_columns(out_cols)
@@ -214,14 +219,18 @@ class PipelineModel(Model):
 
     def _fusion_plan(self) -> _FusionPlan:
         """The cached segment plan; invalidated when the stage list, any
-        stage's params, or any stage's model arrays change (a jitted segment
-        bakes params and array identities at trace time)."""
+        stage's params, or a STATIC stage's model arrays change (a jitted
+        segment bakes params at trace time; model arrays are runtime
+        operands re-fed per dispatch). Swap-capable stages deliberately
+        drop their array identities AND publication counter from the
+        token: a live model swap must reuse the compiled plan — the swap
+        is a new operand value of the same shape, not a new program."""
         token = tuple(
             (
                 id(stage),
                 stage.__dict__.get("_params_version", 0),
-                tuple(id(a) for a in stage._constant_sources())
-                if isinstance(stage, AlgoOperator)
+                (stage.model_data_version,) + tuple(id(a) for a in stage._constant_sources())
+                if isinstance(stage, AlgoOperator) and not getattr(stage, "swap_capable", False)
                 else (),
             )
             for stage in self._stages

@@ -42,6 +42,14 @@ flakes. Mechanisms on top of the fusion planner:
    beyond `config.straggler_factor`× the trailing mean. `health()`
    returns a `ServerHealth` snapshot of all of it.
 
+5. **Model hot-swap hooks** — a `lifecycle.ModelLifecycle` attached via
+   the `lifecycle` param receives every retired batch's guard outcome:
+   swap-capable stages in the served plan (online models) take their
+   model tensors as versioned runtime operands, so a trainer promoting
+   versions mid-serve never pauses this server, and a run of guard
+   errors rolls traffic back to the last-good version automatically
+   (docs/model_lifecycle.md).
+
 Results are yielded IN ORDER. A batch's guard failure (e.g. Bucketizer
 handleInvalid='error') raises when that batch is yielded — at most
 `in_flight` batches later than the eager path would have raised, never
@@ -155,6 +163,7 @@ class MicroBatchServer:
         admission: Optional[int] = None,
         deadline_ms: Optional[float] = None,
         retries: Optional[int] = None,
+        lifecycle=None,
     ):
         if not isinstance(model, PipelineModel):
             raise TypeError(f"MicroBatchServer serves a PipelineModel, got {type(model).__name__}")
@@ -167,6 +176,12 @@ class MicroBatchServer:
         )
         self.deadline_ms = deadline_ms if deadline_ms is not None else config.serving_deadline_ms
         self.retries = retries
+        # optional lifecycle.ModelLifecycle: every retired batch's guard
+        # outcome feeds its sliding health window, so a run of guard
+        # errors (a bad promotion that slipped the gate) triggers the
+        # automatic rollback WITHOUT restarting this server — the swap is
+        # a pointer exchange the next batch picks up
+        self.lifecycle = lifecycle
         self.watchdog = flow.StragglerWatchdog("serving.batch")
         self._buckets_seen: set = set()
         self._counts: Dict[str, int] = {
@@ -247,8 +262,16 @@ class MicroBatchServer:
     def _finish(self, out: Table, pending: List[Tuple[str, Any]], n: int) -> Table:
         """Retire one batch from the in-flight window: ONE packed guard
         readback (the batch's only blocking sync), then slice the padding
-        off on device."""
-        _drain_guards(pending)
+        off on device. The guard outcome feeds the attached lifecycle's
+        health window (rollback trigger)."""
+        try:
+            _drain_guards(pending)
+        except Exception as e:
+            if self.lifecycle is not None:
+                self.lifecycle.record_guard_error(e)
+            raise
+        if self.lifecycle is not None:
+            self.lifecycle.record_serve_ok()
         if out.num_rows == n:
             return out
         return Table({name: _slice_rows(out.column(name), n) for name in out.column_names})

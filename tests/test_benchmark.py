@@ -92,6 +92,7 @@ class TestRunner:
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
             "retryCount", "shedCount", "rejectCount", "peakQueueDepth",
+            "swapCount", "rollbackCount", "promoteRejected",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
         # flow-control fields: a clean run pays no retries/sheds/rejects

@@ -218,6 +218,46 @@ def test_online_lr_batch_kill_resume_bit_identical(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# model lifecycle: kill mid-publish (after persist, before the swap)
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_kill_during_promote_resume_republishes_same_version(tmp_path):
+    """The `lifecycle.swap` fault site sits between the promotion's
+    JobSnapshot write and the pointer swap. A trainer killed in that
+    window never published — the serving model keeps the old version —
+    but the snapshot's `publishedVersion` meta makes the RESUMED job
+    re-publish the validated version instead of regressing to 0."""
+    from flink_ml_tpu.lifecycle import ModelLifecycle
+    from flink_ml_tpu.models.classification.onlinelogisticregression import (
+        OnlineLogisticRegressionModel,
+    )
+
+    def fresh_model():
+        m = OnlineLogisticRegressionModel()
+        m.publish_model_arrays((np.zeros(6),), 0)
+        return m
+
+    ckpt = str(tmp_path / "lifecycle")
+    model = fresh_model()
+    lc = ModelLifecycle(model, checkpoint_dir=ckpt, job_key="tws-kill")
+    lc.promote((np.full(6, 0.5),))  # v1, published + persisted
+    lc.record_serve_ok()
+    killed = np.full(6, 0.75)
+    with faults.inject("lifecycle.swap", after=1):
+        with pytest.raises(InjectedFault):
+            lc.promote((killed,))  # v2: persisted, swap never happened
+    assert model.model_version == 1, "a mid-publish kill must not tear the swap"
+
+    # "restarted" job: fresh model from initial data, same checkpoint dir
+    resumed = fresh_model()
+    lc2 = ModelLifecycle(resumed, checkpoint_dir=ckpt, job_key="tws-kill")
+    assert resumed.model_version == 2, "resume must re-publish the persisted version"
+    np.testing.assert_array_equal(resumed.coefficient, killed)
+    assert lc2.last_good == 1
+    assert lc2.promote((np.full(6, 1.0),)).version_id == 3
+
+
+# ---------------------------------------------------------------------------
 # elastic resume: different virtual-device counts (1→8, 8→2)
 # ---------------------------------------------------------------------------
 

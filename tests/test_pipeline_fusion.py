@@ -267,6 +267,26 @@ def _kmeans():
     return m, {"features": _mat()}
 
 
+def _online_kmeans():
+    from flink_ml_tpu.models.clustering.onlinekmeans import OnlineKMeansModel
+
+    m = OnlineKMeansModel()
+    m.publish_model_arrays((RNG.randn(3, 4), np.ones(3)), 2)
+    m.set_features_col("features").set_prediction_col("pred")
+    return m, {"features": _mat()}
+
+
+def _online_logistic_regression():
+    from flink_ml_tpu.models.classification.onlinelogisticregression import (
+        OnlineLogisticRegressionModel,
+    )
+
+    m = OnlineLogisticRegressionModel()
+    m.publish_model_arrays((RNG.randn(4),), 3)
+    m.set_features_col("features").set_prediction_col("pred")
+    return m, {"features": _mat()}
+
+
 STAGE_BUILDERS = {
     "StandardScalerModel": _standard_scaler,
     "MinMaxScalerModel": _minmax_scaler,
@@ -291,6 +311,8 @@ STAGE_BUILDERS = {
     "LogisticRegressionModel": _logistic_regression,
     "LinearSVCModel": _linear_svc,
     "KMeansModel": _kmeans,
+    "OnlineKMeansModel": _online_kmeans,
+    "OnlineLogisticRegressionModel": _online_logistic_regression,
 }
 
 

@@ -1250,3 +1250,93 @@ class TestChannelProtocol:
         }, ["channel-protocol"])
         assert report.findings == []
         assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-swap publication idioms (lifecycle.py / the online models)
+# ---------------------------------------------------------------------------
+
+class TestHotSwapPublishIdioms:
+    """The fixture pair behind docs/model_lifecycle.md's publication
+    contract: a torn publish guarded by TWO locks taken in inconsistent
+    order is exactly the ABBA inversion `lock-order` exists for, while the
+    shipped single-atomic-reference swap (one immutable record, no lock
+    nesting) lints clean under both concurrency rules."""
+
+    def test_torn_publish_antipattern_is_flagged(self, tmp_path):
+        # anti-pattern: version and arrays live behind separate locks; the
+        # trainer writes arrays-then-version, the server reads
+        # version-then-arrays — a deadlock-or-torn-read protocol
+        report = _run(tmp_path, {
+            "models/torn.py": """
+                import threading
+
+                class TornModel:
+                    def __init__(self):
+                        self._version_lock = threading.Lock()
+                        self._arrays_lock = threading.Lock()
+                        self.version = 0
+                        self.arrays = None
+
+                    def publish(self, arrays, version):
+                        with self._arrays_lock:
+                            self.arrays = arrays
+                            with self._version_lock:
+                                self.version = version
+
+                    def serve_snapshot(self):
+                        with self._version_lock:
+                            version = self.version
+                            with self._arrays_lock:
+                                return version, self.arrays
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["lock-order"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data[0] == "cycle"
+        assert "_arrays_lock" in f.message and "_version_lock" in f.message
+
+    def test_atomic_swap_idiom_is_clean(self, tmp_path):
+        # the shipped idiom: ONE immutable (version, arrays) record behind
+        # ONE reference; the promote worker pumps through a flow channel
+        # it closes on every path
+        report = _run(tmp_path, {
+            "models/swap.py": """
+                from collections import namedtuple
+
+                from .. import flow
+
+                Published = namedtuple("Published", ["version", "arrays"])
+
+                class SwapModel:
+                    def __init__(self):
+                        self._published = Published(0, None)
+
+                    def publish(self, arrays, version):
+                        self._published = Published(version, arrays)
+
+                    def serve_snapshot(self):
+                        pub = self._published
+                        return pub.version, pub.arrays
+
+                class Promoter:
+                    def __init__(self, model, candidates):
+                        self.model = model
+                        self._in = flow.BoundedChannel(4, name="promote.in")
+                        flow.pump(candidates, self._in)
+                        self._worker = flow.spawn(self._run, name="promote")
+
+                    def _run(self):
+                        try:
+                            for version, arrays in self._in:
+                                self.model.publish(arrays, version)
+                        finally:
+                            self._in.cancel()
+            """,
+            **FLOW_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["lock-order", "channel-protocol"])
+        assert report.findings == []
