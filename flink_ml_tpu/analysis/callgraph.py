@@ -279,10 +279,21 @@ class CallGraph:
     def analyze(self, decl: FunctionDecl) -> FunctionAnalysis:
         """Walk ``decl`` once, yielding its local sync events AND its
         summary. Memoized; recursion (a call cycle) sees the empty
-        summary — conservative and terminating."""
+        summary — conservative and terminating. With a prepared
+        :class:`~.cache.SummaryCache` on the project, servable modules'
+        analyses deserialize instead of re-walking (the incremental-lint
+        fast path; finding-parity pinned by tests/test_tpulint.py)."""
         cached = self._analyses.get(decl.key)
         if cached is not None:
             return cached
+        summary_cache = getattr(self.project, "summary_cache", None)
+        if summary_cache is not None:
+            entry = summary_cache.lookup(decl.path, decl.qualname)
+            if entry is not None:
+                events, summary = entry
+                analysis = FunctionAnalysis(decl, events, summary)
+                self._analyses[decl.key] = analysis
+                return analysis
         if decl.key in self._in_progress:
             return FunctionAnalysis(decl, [], EMPTY_SUMMARY)
         self._in_progress.add(decl.key)

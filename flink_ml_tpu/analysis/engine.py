@@ -116,6 +116,8 @@ class Project:
     _by_path: Dict[str, SourceModule] = field(default_factory=dict)
     _by_module_name: Dict[str, SourceModule] = field(default_factory=dict)
     _indexes: Dict[str, Any] = field(default_factory=dict)
+    #: prepared analysis.cache.SummaryCache (incremental lint), or None
+    summary_cache: Any = None
 
     @classmethod
     def load(
@@ -178,6 +180,7 @@ def run(
     rules: Optional[Sequence[Rule]] = None,
     only_paths: Optional[Sequence[str]] = None,
     project: Optional[Project] = None,
+    summary_cache: Any = None,
 ) -> Report:
     """Run ``rules`` (default: all registered) over the tree.
 
@@ -185,11 +188,20 @@ def run(
     files (the ``--changed`` fast path) — project-wide rules still see the
     whole tree, so cross-file invariants cannot be dodged by a partial
     lint; only the blame anchored elsewhere is dropped.
+
+    ``summary_cache`` (analysis.cache.SummaryCache) serves cached
+    call-graph analyses for modules proven clean by content hash (minus
+    the reverse-import closure of the dirty set) and is refreshed from
+    this run's results afterwards — events are cached alongside
+    summaries, so a warm run is finding-identical to a cold one.
     """
     if project is None:
         project = Project.load(root=root, scope=scope)
     if rules is None:
         rules = all_rules()
+    if summary_cache is not None:
+        summary_cache.prepare(project)
+        project.summary_cache = summary_cache
 
     raw: List[Finding] = []
     for rule in rules:
@@ -239,4 +251,10 @@ def run(
         selected = {p.replace("\\", "/") for p in only_paths}
         report.findings = [f for f in report.findings if f.path in selected]
         report.suppressed = [f for f in report.suppressed if f.path in selected]
+
+    if summary_cache is not None:
+        graph = project._indexes.get("callgraph")
+        if graph is not None:
+            summary_cache.store_analyses(graph)
+            summary_cache.save()
     return report

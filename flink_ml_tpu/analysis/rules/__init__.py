@@ -5,10 +5,14 @@ from . import (  # noqa: F401
     accounting,
     channelprotocol,
     coverage,
+    divergence,
     donation,
     flowcontrol,
     hostsync,
     lockorder,
+    meshaxis,
+    precision,
     retrace,
     shardingtags,
+    specconsistency,
 )

@@ -1,0 +1,77 @@
+"""collective-divergence: collectives under shard-varying control flow.
+
+A collective only completes when *every* participant along the axis
+issues it. Inside a ``shard_map`` body, a branch whose condition varies
+per shard — an ``axis_index`` comparison, a test on sharded data, a
+``while_loop`` whose trip count depends on a per-shard value — lets some
+shards reach the collective while others skip it. On the single-host
+virtual-device mesh this usually traces into *one* program and the
+hazard hides; on a real multi-host DCN mesh each host traces and runs
+its own copy of the python, and the mismatch is a **deadlock**: the
+fast hosts park in the collective forever while the slow ones never
+arrive. That is precisely the failure mode the 2D-mesh + multi-host
+work (ROADMAP item 1) cannot debug numerically — a hung job has no
+numbers.
+
+The static detector is the interpreter's divergence context: entering
+an ``if``/``while``/``for`` whose test varies per shard (or a
+``lax.cond``/``switch``/``while_loop`` whose predicate does) marks the
+region, and any reduce/gather/permute reached inside it is flagged with
+both the collective's line and the branching line. The dynamic dual is
+the sanitizer's collective-sequence recorder
+(``FLINK_ML_TPU_SANITIZE=1``): it records the per-shard (op, axis,
+shape, dtype) sequence and fails at exit on cross-shard divergence —
+each side covers the other's blind spot.
+
+The sanctioned shape for rank-dependent communication is data-dependent
+*content* with rank-independent *structure*: every shard issues the same
+collective and masks its contribution (weight 0, zero padding), exactly
+how the padded-batch convention already works.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .. import spmd
+from ..engine import Finding, Rule, register
+
+
+@register
+class CollectiveDivergenceRule(Rule):
+    id = "collective-divergence"
+    title = "collective reachable under a shard-varying branch"
+    rationale = (
+        "A collective completes only when every shard along the axis "
+        "issues it; a branch that varies per shard (axis_index tests, "
+        "conditions on sharded data, data-dependent loop trip counts) "
+        "lets some shards skip it. Single-host tracing hides the bug; a "
+        "multi-host DCN mesh turns it into a deadlock with no error "
+        "message. Keep the collective STRUCTURE uniform and make the "
+        "contribution data-dependent instead (mask with weight 0 / zero "
+        "padding, the padded-batch convention)."
+    )
+    example = "if axis_index(DATA_AXIS) == 0:\n    x = all_reduce_sum(x, DATA_AXIS)"
+    scope = ("flink_ml_tpu",)
+
+    def check_project(self, project) -> Iterable[Finding]:
+        interp = spmd.interpretation(project)
+        for event in interp.of_kind("divergent-collective"):
+            if not self.applies_to(event.path):
+                continue
+            branch_line = event.extra[0] if event.extra else "?"
+            reason = event.extra[1] if len(event.extra) > 1 else "shard-varying branch"
+            axis = event.extra[2] if len(event.extra) > 2 else "?"
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"{event.detail} over axis {axis!r} is reachable only "
+                    f"under the branch at line {branch_line} ({reason}) — "
+                    "shards that take the other arm never issue the "
+                    "collective, which deadlocks a multi-host mesh; issue "
+                    "it unconditionally and mask the contribution instead"
+                ),
+                data=("divergent", event.detail, branch_line),
+            )

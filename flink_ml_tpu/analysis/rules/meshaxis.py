@@ -1,0 +1,100 @@
+"""mesh-axis: every collective axis name must exist and ride a constant.
+
+The upcoming 2D-mesh rebuild of ``parallel/mesh.py`` adds a second axis
+name to every spec and axis-restricted collective in the training
+programs. An axis-name typo does not fail fast: ``psum(x, "dta")``
+errors only when the program is traced under a mesh — possibly a
+production mesh an hour into a job — and a *valid-but-wrong* axis name
+(``"model"`` where ``"data"`` was meant) silently reduces over the wrong
+dimension of the machine. The rule leans on the SPMD layer
+(``analysis/spmd.py``):
+
+1. **unknown axis** — an axis literal at a collective call, inside a
+   ``P(...)`` spec, or in a ``create_mesh``/``Mesh`` axis tuple that no
+   ``*_AXIS`` constant in ``parallel/mesh.py`` declares;
+2. **constant bypass** — a literal that duplicates a declared constant
+   (``"data"`` instead of ``DATA_AXIS``): renaming an axis would miss
+   it, and the 2D-mesh PR renames axes;
+3. **unsharded collective** — a gather/permute over an axis the abstract
+   operand does not vary on (the interpreter propagates in_specs through
+   the shard_map body): the collective moves bytes to replicate what was
+   already replicated, or — worse — the spec is wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .. import spmd
+from ..engine import Finding, Rule, register
+
+
+@register
+class MeshAxisRule(Rule):
+    id = "mesh-axis"
+    title = "collective/spec axis name unknown, literal, or unsharded"
+    rationale = (
+        "An axis-name typo surfaces only when the program traces under a "
+        "mesh — the worst moment — and a valid-but-wrong axis silently "
+        "reduces over the wrong dimension of the machine. Axis names are "
+        "declared ONCE as *_AXIS constants in parallel/mesh.py; every "
+        "collective call, P(...) spec, and mesh construction must use the "
+        "constants (a literal would survive an axis rename), name a "
+        "declared axis, and gather/permute only over axes the operand is "
+        "actually sharded on."
+    )
+    example = 'grad = all_reduce_sum(grad, "dta")  # unknown axis, literal'
+    scope = ("flink_ml_tpu",)
+
+    def check_project(self, project) -> Iterable[Finding]:
+        interp = spmd.interpretation(project)
+        reg = spmd.axis_registry(project)
+        known = ", ".join(sorted(reg.known_axes)) or "<none declared>"
+        for event in interp.of_kind("unknown-axis"):
+            if not self.applies_to(event.path):
+                continue
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"axis name {event.detail!r} is not declared by any "
+                    f"*_AXIS constant in parallel/mesh.py (known: {known}) "
+                    "— this traces only under a mesh that happens to have "
+                    "it, and fails (or silently mis-reduces) everywhere else"
+                ),
+                data=("unknown-axis", event.detail),
+            )
+        for event in interp.of_kind("axis-bypass"):
+            if not self.applies_to(event.path):
+                continue
+            const = event.extra[0] if event.extra else ""
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"string literal {event.detail!r} bypasses the "
+                    f"{const or '*_AXIS'} constant (parallel/mesh.py) — an "
+                    "axis rename in the 2D-mesh work would silently miss "
+                    "this site; import the constant instead"
+                ),
+                data=("axis-bypass", event.detail, const),
+            )
+        for event in interp.of_kind("unsharded-collective"):
+            if not self.applies_to(event.path):
+                continue
+            axis = event.extra[0] if event.extra else "?"
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"{event.detail} over axis {axis!r} but the operand is "
+                    "not sharded on that axis (per the in_specs the "
+                    "interpreter propagated) — the collective replicates a "
+                    "replica, which means either wasted wire bytes or a "
+                    "wrong PartitionSpec"
+                ),
+                data=("unsharded-collective", event.detail, axis),
+            )

@@ -1,0 +1,108 @@
+"""spec-consistency: in_specs → body → out_specs must tell one story.
+
+``shard_map``'s ``out_specs`` are a *claim*: this output is replicated /
+sharded thus. jax checks the claim only as far as shapes go — a body
+that returns a **per-shard partial sum** under ``out_specs=P()`` does
+not error, it silently publishes shard 0's partial (or, with vma checks
+off, whatever the backend picks) as if it were the global result. The
+mirror bug is reducing a value that is *already* uniform along the
+axis: ``psum`` of a replicated operand multiplies it by the shard count
+— the classic double-counting that makes a loss exactly N× too large
+and an N-device run "converge" to different coefficients than a
+1-device run.
+
+The interpreter (``analysis/spmd.py``) propagates the in_specs through
+the body as variance sets, so this rule can flag both ends statically:
+
+- **unreduced-output** — a return value still varies over mesh axes the
+  out_spec says it does not have (declared replicated, never reduced);
+- **double-reduce** — a reduction over an axis the operand is already
+  uniform on (never sharded there, or already reduced once);
+- **spec-arity** — ``in_specs`` entry count does not match the body's
+  parameters (specs silently zip-truncate; the tail params get
+  whatever jax defaults to).
+
+Unknown specs / unresolvable values suppress findings — the engine
+under-approximates, so every finding is worth reading.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .. import spmd
+from ..engine import Finding, Rule, register
+
+
+@register
+class SpecConsistencyRule(Rule):
+    id = "spec-consistency"
+    title = "shard_map specs inconsistent with the body's reductions"
+    rationale = (
+        "out_specs are a claim jax does not verify semantically: a "
+        "per-shard partial returned under P() silently publishes one "
+        "shard's partial as the global result, and a psum of an "
+        "already-replicated operand multiplies by the shard count "
+        "(double-counting) — both are silent numeric corruption, the "
+        "exact class of bug the 2D-mesh work would otherwise have to "
+        "debug from wrong coefficients. The abstract interpreter "
+        "propagates in_specs through the body so both directions are "
+        "caught at lint time."
+    )
+    example = "shard_map_over(mesh, (P(DATA_AXIS),), P(), fn=body)  # body never reduces"
+    scope = ("flink_ml_tpu",)
+
+    def check_project(self, project) -> Iterable[Finding]:
+        interp = spmd.interpretation(project)
+        for event in interp.of_kind("unreduced-output"):
+            if not self.applies_to(event.path):
+                continue
+            axes = ", ".join(event.extra[0]) if event.extra else "?"
+            site_line = event.extra[1] if len(event.extra) > 1 else "?"
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"return value of {event.detail}() still varies over "
+                    f"axis ({axes}) but the out_specs at line {site_line} "
+                    "declare it reduced/replicated there — the program "
+                    "publishes a per-shard partial as the global result; "
+                    "reduce it (all_reduce_sum / all_gather) before "
+                    "returning, or declare the sharded layout"
+                ),
+                data=("unreduced-output", event.detail) + tuple(event.extra[:1]),
+            )
+        for event in interp.of_kind("double-reduce"):
+            if not self.applies_to(event.path):
+                continue
+            axis = event.extra[0] if event.extra else "?"
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"{event.detail} over axis {axis!r} but the operand is "
+                    "already uniform along that axis — the reduction "
+                    "multiplies by the shard count (double-counting); drop "
+                    "the redundant reduce or fix the PartitionSpec that "
+                    "claimed the operand replicated"
+                ),
+                data=("double-reduce", event.detail, axis),
+            )
+        for event in interp.of_kind("spec-arity"):
+            if not self.applies_to(event.path):
+                continue
+            n_specs, n_params = (event.extra + ("?", "?"))[:2]
+            yield Finding(
+                path=event.path,
+                line=event.line,
+                rule=self.id,
+                message=(
+                    f"in_specs carries {n_specs} spec(s) but {event.detail}() "
+                    f"takes {n_params} parameter(s) — specs zip against "
+                    "params positionally, so the mismatch silently mis-"
+                    "shards the tail"
+                ),
+                data=("spec-arity", event.detail),
+            )

@@ -16,7 +16,17 @@ end — see tests/conftest.py) the recorder fails on:
   the silently-stalled-worker state the flow contract exists to kill;
 - **unclosed pump channels** — a channel that had a producer worker
   attached but was never closed (by the worker) or cancelled (by the
-  consumer).
+  consumer);
+- **collective-sequence divergence** — the dynamic dual of the static
+  ``collective-divergence`` rule (tpulint v3): every accounted
+  collective (``parallel/collectives.py`` funnels through
+  ``record_collective``) appends its ``(op, axis, shape, dtype)`` to
+  the sequence of the current *shard scope* (``Recorder.shard_scope``
+  — entered by per-shard host-driven paths and the multi-host
+  emulation; default scope = the single trace context), and at exit
+  every shard of a scope group must have recorded the SAME sequence.
+  A mismatch is the SPMD-divergence deadlock caught in the virtual
+  mesh instead of hung on a production DCN fabric.
 
 The static rules (`lock-order`, `channel-protocol`) prove the *code*
 cannot express an inversion the analyzer can see; the sanitizer proves
@@ -49,6 +59,8 @@ __all__ = [
     "tracked_lock",
     "tracked_rlock",
     "tracked_condition",
+    "record_collective",
+    "collective_recording",
 ]
 
 
@@ -73,6 +85,10 @@ class Recorder:
         # id(channel) -> [name, pumped, closed]
         self._channels: Dict[int, List] = {}
         self._workers: List[Tuple[threading.Thread, str]] = []
+        # group -> shard -> [(op, axis, shape, dtype), ...]
+        self.collective_sequences: Dict[str, Dict[str, List[Tuple]]] = {}
+        self.collective_count = 0
+        self._shard_ctx = threading.local()  # per-thread (group, shard)
 
     # -- lock events ---------------------------------------------------------
     def _stack(self) -> List[str]:
@@ -129,6 +145,88 @@ class Recorder:
         with self._mu:
             self._workers.append((thread, kind))
 
+    # -- collective-sequence ledger -------------------------------------------
+    def shard_scope(self, shard, group: str = "mesh"):
+        """Context manager entering a per-shard recording scope: every
+        collective recorded inside appends to ``group``'s sequence for
+        ``shard``. Per-shard host-driven paths (and the multi-host
+        emulation, one scope per virtual host) wrap their per-shard work
+        in this so divergence across shards is observable."""
+        rec = self
+
+        class _Scope:
+            def __enter__(self_inner):
+                prev = getattr(rec._shard_ctx, "scope", None)
+                rec._shard_ctx.scope = (str(group), str(shard))
+                self_inner._prev = prev
+                return rec
+
+            def __exit__(self_inner, *exc):
+                rec._shard_ctx.scope = self_inner._prev
+                return False
+
+        return _Scope()
+
+    def record_collective(self, op: str, axis, shape, dtype) -> None:
+        """One accounted collective: appended to the current shard
+        scope's sequence (default scope: the process-wide trace context,
+        which cannot diverge against itself)."""
+        scope = getattr(self._shard_ctx, "scope", None)
+        if scope is None:
+            scope = ("trace", "0")
+        group, shard = scope
+        event = (str(op), str(axis), tuple(shape), str(dtype))
+        with self._mu:
+            self.collective_sequences.setdefault(group, {}).setdefault(
+                shard, []
+            ).append(event)
+            self.collective_count += 1
+
+    def collective_divergences(self) -> List[str]:
+        """Cross-shard sequence mismatches, one message per group."""
+        with self._mu:
+            groups = {
+                g: {s: list(seq) for s, seq in shards.items()}
+                for g, shards in self.collective_sequences.items()
+            }
+        out: List[str] = []
+        for group, shards in sorted(groups.items()):
+            if len(shards) < 2:
+                continue
+            names = sorted(shards)
+            ref_name, ref = names[0], shards[names[0]]
+            for name in names[1:]:
+                seq = shards[name]
+                limit = min(len(ref), len(seq))
+                mismatch = next(
+                    (i for i in range(limit) if ref[i] != seq[i]), None
+                )
+                if mismatch is None and len(ref) == len(seq):
+                    continue
+                if mismatch is None:
+                    longer, shorter = (
+                        (ref_name, name) if len(ref) > len(seq) else (name, ref_name)
+                    )
+                    extra = (ref if len(ref) > len(seq) else seq)[limit]
+                    out.append(
+                        f"collective-sequence divergence in group {group!r}: "
+                        f"shard {longer!r} issued {extra} at position {limit} "
+                        f"but shard {shorter!r} ended after {limit} "
+                        "collective(s) — the shorter shard would deadlock "
+                        "the longer one on a real mesh"
+                    )
+                else:
+                    out.append(
+                        f"collective-sequence divergence in group {group!r} "
+                        f"at position {mismatch}: shard {ref_name!r} issued "
+                        f"{ref[mismatch]} but shard {name!r} issued "
+                        f"{seq[mismatch]} — mismatched collectives deadlock "
+                        "a multi-host mesh (see the collective-divergence "
+                        "lint rule for the static dual)"
+                    )
+                break  # one message per divergent pair is enough evidence
+        return out
+
     # -- verdicts ------------------------------------------------------------
     def cycles(self) -> List[List[str]]:
         """Elementary cycles in the recorded acquisition DAG (one
@@ -184,6 +282,7 @@ class Recorder:
                     f"unclosed pump channel {name!r}: a producer worker was "
                     "attached but close()/cancel() never ran"
                 )
+        out.extend(self.collective_divergences())
         return out
 
     def check(self, join_timeout: float = 2.0) -> None:
@@ -203,6 +302,8 @@ class Recorder:
                 "channels": len(self._channels),
                 "channelsClosed": sum(1 for c in self._channels.values() if c[2]),
                 "workers": len(self._workers),
+                "collectives": self.collective_count,
+                "collectiveGroups": len(self.collective_sequences),
             }
 
 
@@ -299,6 +400,46 @@ def tracked_condition(name: str, rec: Optional[Recorder] = None) -> TrackedCondi
 
 
 # ---------------------------------------------------------------------------
+# collective-sequence funnel
+# ---------------------------------------------------------------------------
+
+#: flipped by enable() (or collective_recording) — parallel/collectives.py
+#: calls record_collective on every accounted collective and this keeps
+#: the un-sanitized fast path at one boolean check
+_collectives_on = False
+_collective_recorder: Optional[Recorder] = None
+
+
+def record_collective(op: str, axis, shape, dtype) -> None:
+    """Funnel for ``parallel/collectives._account``: no-op unless the
+    sanitizer (or a scoped :func:`collective_recording`) is active."""
+    if not _collectives_on:
+        return
+    rec = _collective_recorder if _collective_recorder is not None else recorder
+    rec.record_collective(op, axis, shape, dtype)
+
+
+class collective_recording:
+    """Scoped recording into a throwaway recorder (unit tests / ad-hoc
+    drivers) without globally instrumenting the flow layer."""
+
+    def __init__(self, rec: Optional[Recorder] = None):
+        self.rec = rec if rec is not None else Recorder()
+
+    def __enter__(self) -> Recorder:
+        global _collectives_on, _collective_recorder
+        self._prev = (_collectives_on, _collective_recorder)
+        _collectives_on = True
+        _collective_recorder = self.rec
+        return self.rec
+
+    def __exit__(self, *exc):
+        global _collectives_on, _collective_recorder
+        _collectives_on, _collective_recorder = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
 # instrumentation
 # ---------------------------------------------------------------------------
 
@@ -310,10 +451,11 @@ def enable(register_atexit: bool = True) -> None:
     """Instrument the flow layer (idempotent). Called automatically by
     tests/conftest.py when ``FLINK_ML_TPU_SANITIZE=1``; safe to call
     directly from a driver process."""
-    global _enabled
+    global _enabled, _collectives_on
     if _enabled:
         return
     _enabled = True
+    _collectives_on = True  # collectives._account starts feeding the ledger
 
     from .. import flow
     from ..obs import tracing
@@ -384,5 +526,6 @@ def _atexit_check() -> None:
         "FLINK_ML_TPU_SANITIZE: clean "
         f"({recorder.stats()['acquisitions']} acquisitions, "
         f"{recorder.stats()['workers']} workers, "
-        f"{recorder.stats()['channels']} channels)\n"
+        f"{recorder.stats()['channels']} channels, "
+        f"{recorder.stats()['collectives']} collectives)\n"
     )

@@ -37,9 +37,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import sanitizer as _sanitizer
 from ..obs import tracing
 from ..utils import metrics
-from .mesh import DATA_AXIS
+
+# the axis-name constants are DECLARED in mesh.py and re-exported here so
+# model/ops code that already imports collectives needs no second import —
+# the mesh-axis lint rule resolves either path to the same constant
+from .mesh import DATA_AXIS, MODEL_AXIS  # noqa: F401  (MODEL_AXIS re-export)
 
 
 def _iter_array_leaves(x):
@@ -83,6 +88,15 @@ def _account(op: str, x, axis_name: str, chunks: int = None, dense_equiv_bytes: 
     the device profile answers how long it took."""
     leaves = list(_iter_array_leaves(x))
     nbytes = sum(int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize for leaf in leaves)
+    # sanitizer collective-sequence ledger (FLINK_ML_TPU_SANITIZE=1): the
+    # per-shard (op, axis, shape, dtype) sequence must match across shard
+    # scopes at exit — the dynamic dual of the collective-divergence rule
+    if leaves:
+        _sanitizer.record_collective(
+            op, axis_name, leaves[0].shape, np.dtype(leaves[0].dtype).name
+        )
+    else:
+        _sanitizer.record_collective(op, axis_name, (), "none")
     tracing.account_collective(
         op,
         nbytes,

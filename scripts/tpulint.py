@@ -13,6 +13,12 @@ Usage:
   scripts/tpulint.py --show-suppressed   # also print what suppressions hid
   scripts/tpulint.py --format json       # machine-readable findings
                                          # (file/line/rule/message/chain)
+  scripts/tpulint.py --format sarif      # SARIF 2.1.0 (CI PR annotations)
+  scripts/tpulint.py --changed           # uses the incremental summary
+                                         # cache (.tpulint_cache.json) —
+                                         # clean modules' call-graph walks
+                                         # deserialize instead of re-running;
+                                         # --no-cache forces a cold pass
 
 Exit status: 0 when there are no unsuppressed findings, 1 otherwise.
 Suppress a deliberate finding with an inline (or preceding-line) comment:
@@ -96,6 +102,80 @@ def _finding_json(finding) -> dict:
     }
 
 
+def _sarif_result(finding, suppressed: bool) -> dict:
+    result = {
+        "ruleId": finding.rule,
+        "level": "error",
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, int(finding.line))},
+                }
+            }
+        ],
+    }
+    if suppressed:
+        # in-source `# tpulint: disable=` annotations map onto SARIF's
+        # first-class suppression object, so viewers show the census
+        # without failing the run
+        result["suppressions"] = [{"kind": "inSource"}]
+    return result
+
+
+def _sarif_report(report) -> dict:
+    """SARIF 2.1.0 — one run, the rule catalogue as driver metadata, every
+    finding (and suppressed census entry) as a result. Uploaded by the CI
+    workflow so findings annotate PR diffs."""
+    rules_meta = []
+    for rule in engine.all_rules():
+        rules_meta.append(
+            {
+                "id": rule.id,
+                "name": rule.id,
+                "shortDescription": {"text": rule.title},
+                "fullDescription": {"text": rule.rationale},
+                "defaultConfiguration": {"level": "error"},
+            }
+        )
+    rules_meta.append(
+        {
+            "id": engine.UNUSED_SUPPRESSION,
+            "name": engine.UNUSED_SUPPRESSION,
+            "shortDescription": {
+                "text": "a tpulint suppression that matches no finding"
+            },
+            "defaultConfiguration": {"level": "error"},
+        }
+    )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rules_meta,
+                    }
+                },
+                "results": [
+                    _sarif_result(f, suppressed=False) for f in report.findings
+                ]
+                + [_sarif_result(f, suppressed=True) for f in report.suppressed],
+            }
+        ],
+    }
+
+
 def _list_rules() -> int:
     for rule in engine.all_rules():
         print(f"{rule.id}: {rule.title}")
@@ -143,10 +223,24 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
         help="output format: json emits one machine-readable object "
-        "(findings + suppressed census, each with file/line/rule/chain)",
+        "(findings + suppressed census, each with file/line/rule/chain); "
+        "sarif emits SARIF 2.1.0 for CI PR annotation",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="skip the incremental summary cache (.tpulint_cache.json) "
+        "that --changed uses to serve clean modules' call-graph analyses "
+        "from disk",
+    )
+    parser.add_argument(
+        "--cache",
+        action="store_true",
+        help="use (and refresh) the summary cache on a full run too, "
+        "warming it for the next --changed pass",
     )
     parser.add_argument(
         "--root",
@@ -182,6 +276,10 @@ def main(argv=None) -> int:
         elif not only_paths:
             if args.format == "json":
                 print(json.dumps({"clean": True, "findings": [], "suppressed": []}))
+            elif args.format == "sarif":
+                from flink_ml_tpu.analysis.engine import Report  # noqa: E402
+
+                print(json.dumps(_sarif_report(Report()), indent=2))
             else:
                 print("tpulint: no files differ from HEAD")
             return 0
@@ -196,7 +294,26 @@ def main(argv=None) -> int:
             else sorted(set(only_paths) & set(normalized))
         )
 
-    report = engine.run(root=root, rules=rules, only_paths=only_paths)
+    summary_cache = None
+    if not args.no_cache and (args.changed or args.cache):
+        from flink_ml_tpu.analysis import cache as _cache  # noqa: E402
+
+        summary_cache = _cache.SummaryCache.load(_cache.cache_path(root))
+
+    report = engine.run(
+        root=root, rules=rules, only_paths=only_paths, summary_cache=summary_cache
+    )
+    if summary_cache is not None:
+        print(
+            f"tpulint: summary cache {len(summary_cache.servable)} clean / "
+            f"{len(summary_cache.dirty)} dirty module(s), "
+            f"{summary_cache.hits} analyses served",
+            file=sys.stderr,
+        )
+
+    if args.format == "sarif":
+        print(json.dumps(_sarif_report(report), indent=2))
+        return report.exit_code
 
     if args.format == "json":
         print(

@@ -48,7 +48,9 @@ def pytest_sessionfinish(session, exitstatus):
         emit(
             "FLINK_ML_TPU_SANITIZE: clean — "
             f"{stats['acquisitions']} acquisitions, {stats['workers']} workers, "
-            f"{stats['channelsClosed']}/{stats['channels']} channels closed"
+            f"{stats['channelsClosed']}/{stats['channels']} channels closed, "
+            f"{stats['collectives']} collectives in {stats['collectiveGroups']} "
+            "scope group(s)"
         )
 
 
@@ -56,7 +58,7 @@ def pytest_sessionfinish(session, exitstatus):
 def mesh8():
     from flink_ml_tpu.parallel import mesh as mesh_lib
 
-    m = mesh_lib.create_mesh(("data",))
+    m = mesh_lib.create_mesh((mesh_lib.DATA_AXIS,))
     with mesh_lib.use_mesh(m):
         yield m
 
@@ -66,7 +68,9 @@ def mesh_2d():
     """4x2 (data, model) mesh for feature-sharded tests."""
     from flink_ml_tpu.parallel import mesh as mesh_lib
 
-    m = mesh_lib.create_mesh(("data", "model"), shape=(4, 2))
+    m = mesh_lib.create_mesh(
+        (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS), shape=(4, 2)
+    )
     with mesh_lib.use_mesh(m):
         yield m
 

@@ -199,3 +199,116 @@ class TestInstrumentedFlowEndToEnd:
         )
         assert result.returncode == 0, result.stdout + result.stderr
         assert "FLINK_ML_TPU_SANITIZE: clean" in result.stderr
+
+
+class TestCollectiveSequenceRecorder:
+    """The dynamic dual of the collective-divergence lint rule: per-shard
+    (op, axis, shape, dtype) sequences recorded through the
+    parallel/collectives accounting funnel must agree across the shards
+    of a scope group at exit."""
+
+    def test_matching_sequences_are_clean(self):
+        rec = sanitizer.Recorder()
+        for shard in (0, 1, 2):
+            with rec.shard_scope(shard, group="hosts"):
+                rec.record_collective("psum", "data", (128,), "float32")
+                rec.record_collective("all_gather", "data", (16,), "float32")
+        assert rec.collective_divergences() == []
+        rec.check(join_timeout=0.01)  # no raise
+
+    def test_mismatched_op_is_a_divergence(self):
+        rec = sanitizer.Recorder()
+        with rec.shard_scope(0, group="hosts"):
+            rec.record_collective("psum", "data", (128,), "float32")
+        with rec.shard_scope(1, group="hosts"):
+            rec.record_collective("all_gather", "data", (128,), "float32")
+        problems = rec.problems(join_timeout=0.01)
+        assert any("collective-sequence divergence" in p for p in problems)
+        with pytest.raises(sanitizer.SanitizerError):
+            rec.check(join_timeout=0.01)
+
+    def test_missing_trailing_collective_is_a_divergence(self):
+        # the deadlock shape: one shard issues an extra collective the
+        # others never arrive at
+        rec = sanitizer.Recorder()
+        with rec.shard_scope("host0", group="dcn"):
+            rec.record_collective("psum", "data", (4,), "float32")
+            rec.record_collective("psum", "data", (4,), "float32")
+        with rec.shard_scope("host1", group="dcn"):
+            rec.record_collective("psum", "data", (4,), "float32")
+        problems = rec.problems(join_timeout=0.01)
+        assert any("deadlock" in p for p in problems)
+
+    def test_shape_dtype_mismatch_is_a_divergence(self):
+        rec = sanitizer.Recorder()
+        with rec.shard_scope(0, group="hosts"):
+            rec.record_collective("psum", "data", (128,), "float32")
+        with rec.shard_scope(1, group="hosts"):
+            rec.record_collective("psum", "data", (128,), "bfloat16")
+        assert rec.collective_divergences()
+
+    def test_single_scope_and_default_trace_context_cannot_diverge(self):
+        rec = sanitizer.Recorder()
+        rec.record_collective("psum", "data", (8,), "float32")
+        rec.record_collective("all_gather", "data", (8,), "float32")
+        with rec.shard_scope(0, group="solo"):
+            rec.record_collective("psum", "data", (8,), "float32")
+        assert rec.collective_divergences() == []
+
+    def test_real_collectives_record_through_the_accounting_funnel(self, mesh8):
+        """An actual traced shard_map program: the accounted wrapper
+        feeds the ledger with the op, axis, and trace-time shape/dtype."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from flink_ml_tpu.parallel import collectives, mesh as mesh_lib
+
+        with sanitizer.collective_recording() as rec:
+            fn = collectives.shard_map_over(
+                mesh8,
+                P(mesh_lib.DATA_AXIS),
+                P(),
+                fn=lambda x: collectives.all_reduce_sum(x, mesh_lib.DATA_AXIS),
+            )
+            out = fn(jnp.arange(8, dtype=jnp.float32))
+        assert float(out.sum()) == 28.0
+        seqs = rec.collective_sequences["trace"]["0"]
+        assert ("psum", "data", (1,), "float32") in seqs
+        # scoped recording detaches afterwards: nothing else records
+        before = rec.collective_count
+        collectives.payload_bytes(jnp.zeros(4))
+        assert rec.collective_count == before
+
+    def test_divergence_provocation_fails_at_exit_code_66(self):
+        """Subprocess provocation: two emulated hosts drive DIFFERENT
+        collective sequences under FLINK_ML_TPU_SANITIZE=1 — the process
+        must die with the sanitizer's exit code and name the divergence."""
+        result = _run_script(
+            """
+            from flink_ml_tpu.analysis import sanitizer
+            sanitizer.enable()
+            rec = sanitizer.recorder
+            with rec.shard_scope("host0", group="dcn"):
+                sanitizer.record_collective("psum", "data", (1024,), "float32")
+                sanitizer.record_collective("all_gather", "data", (64,), "float32")
+            with rec.shard_scope("host1", group="dcn"):
+                sanitizer.record_collective("psum", "data", (1024,), "float32")
+            """
+        )
+        assert result.returncode == 66, result.stdout + result.stderr
+        assert "collective-sequence divergence" in result.stderr
+
+    def test_matching_sequences_exit_clean_with_ledger_stats(self):
+        result = _run_script(
+            """
+            from flink_ml_tpu.analysis import sanitizer
+            sanitizer.enable()
+            rec = sanitizer.recorder
+            for host in ("host0", "host1"):
+                with rec.shard_scope(host, group="dcn"):
+                    sanitizer.record_collective("psum", "data", (1024,), "float32")
+            """
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "FLINK_ML_TPU_SANITIZE: clean" in result.stderr
+        assert "2 collectives" in result.stderr

@@ -80,6 +80,33 @@ def _seed_tree(tmp_path, rel, source, extra=None):
     return tmp_path
 
 
+#: minimal mesh+collectives pair for the SPMD-rule seeds (same relative
+#: paths the real package anchors on); referenced by name in SEED_CASES
+#: so four cases share one copy
+SPMD_STUB_FILES = {
+    "parallel/__init__.py": "",
+    "parallel/mesh.py": (
+        'DATA_AXIS = "data"\n'
+        'MODEL_AXIS = "model"\n'
+        "def create_mesh(axis_names=(DATA_AXIS,), shape=None, devices=None):\n"
+        "    pass\n"
+    ),
+    "parallel/collectives.py": (
+        "from jax import lax\n"
+        "from .mesh import DATA_AXIS, MODEL_AXIS\n"
+        "def all_reduce_sum(x, axis_name=DATA_AXIS):\n"
+        "    return lax.psum(x, axis_name)\n"
+        "def all_gather(x, axis_name=DATA_AXIS, axis=0, tiled=True):\n"
+        "    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)\n"
+        "def ppermute_ring(x, axis_name=DATA_AXIS, shift=1):\n"
+        "    return lax.ppermute(x, axis_name, [(0, 0)])\n"
+        "def axis_index(axis_name=DATA_AXIS):\n"
+        "    return lax.axis_index(axis_name)\n"
+        "def shard_map_over(mesh, in_specs, out_specs, fn=None, check_vma=False):\n"
+        "    return fn\n"
+    ),
+}
+
 SEED_CASES = [
     (
         "raw-jax-jit",
@@ -129,6 +156,78 @@ SEED_CASES = [
         None,
     ),
     (
+        "unknown-mesh-axis",
+        "models/bad.py",
+        """
+        from ..parallel.collectives import all_reduce_sum
+
+        def reduce(x):
+            return all_reduce_sum(x, "dta")
+        """,
+        "mesh-axis",
+        "flink_ml_tpu/models/bad.py:5",
+        "SPMD_STUB",
+    ),
+    (
+        "divergent-branch-psum",
+        "models/bad.py",
+        """
+        from jax.sharding import PartitionSpec as P
+        from ..parallel import collectives
+        from ..parallel.mesh import DATA_AXIS
+
+        def build(mesh):
+            def body(x):
+                i = collectives.axis_index(DATA_AXIS)
+                if i == 0:
+                    x = collectives.all_reduce_sum(x, DATA_AXIS)
+                return x
+            return collectives.shard_map_over(
+                mesh, (P(DATA_AXIS),), P(DATA_AXIS), fn=body)
+        """,
+        "collective-divergence",
+        "flink_ml_tpu/models/bad.py:10",
+        "SPMD_STUB",
+    ),
+    (
+        "replicated-output-never-reduced",
+        "models/bad.py",
+        """
+        from jax.sharding import PartitionSpec as P
+        from ..parallel import collectives
+        from ..parallel.mesh import DATA_AXIS
+
+        def build(mesh):
+            def body(x):
+                return x * 2.0
+            return collectives.shard_map_over(
+                mesh, (P(DATA_AXIS),), P(), fn=body)
+        """,
+        "spec-consistency",
+        "flink_ml_tpu/models/bad.py:8",
+        "SPMD_STUB",
+    ),
+    (
+        "downcast-before-reduce",
+        "models/bad.py",
+        """
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from ..parallel import collectives
+        from ..parallel.mesh import DATA_AXIS
+
+        def build(mesh):
+            def body(x):
+                return collectives.all_reduce_sum(
+                    x.astype(jnp.bfloat16), DATA_AXIS)
+            return collectives.shard_map_over(
+                mesh, (P(DATA_AXIS),), P(), fn=body)
+        """,
+        "precision-determinism",
+        "flink_ml_tpu/models/bad.py:9",
+        "SPMD_STUB",
+    ),
+    (
         "unknown-ckpt-tag",
         "models/bad.py",
         """
@@ -176,6 +275,8 @@ def test_seeded_known_bad_fixture_fails_with_location(
 ):
     """Acceptance contract: seeding any single known-bad fixture makes the
     CLI exit 1 and name the file:line and rule id."""
+    if extra == "SPMD_STUB":
+        extra = SPMD_STUB_FILES
     root = _seed_tree(tmp_path, rel, source, extra)
     result = _run_cli("--root", str(root), "--rule", rule)
     assert result.returncode == 1, result.stdout + result.stderr
@@ -426,3 +527,206 @@ def test_changed_mode_outside_git_falls_back_to_full_lint(tmp_path):
     assert result.returncode == 1, result.stdout + result.stderr
     assert "linting the whole tree" in result.stderr
     assert "flink_ml_tpu/models/bad.py:6" in result.stdout
+
+
+# ---------------------------------------------------------------------------
+# incremental lint: the summary cache must be finding-identical to cold
+# ---------------------------------------------------------------------------
+
+HELPER_CLEAN = """
+def prepare(x):
+    return x
+"""
+
+#: the edit: the helper gains a host sync, so the UNCHANGED caller module
+#: must be re-analyzed (reverse-dependency invalidation) to inherit the
+#: interprocedural finding
+HELPER_SYNCING = """
+import numpy as np
+
+
+def prepare(x):
+    return np.asarray(x)
+"""
+
+CALLER = """
+import jax.numpy as jnp
+
+from .helper import prepare
+
+
+def fit(X):
+    dev = jnp.sum(X, axis=0)
+    return prepare(dev)
+"""
+
+
+def _cache_tree(tmp_path, helper_src):
+    import textwrap as _tw
+
+    files = {
+        "__init__.py": "",
+        "utils/__init__.py": "",
+        "utils/lazyjit.py": "def lazy_jit(fn, **kw):\n    return fn\n",
+        "models/__init__.py": "",
+        "models/helper.py": _tw.dedent(helper_src),
+        "models/caller.py": _tw.dedent(CALLER),
+    }
+    for name, src in files.items():
+        path = tmp_path / "flink_ml_tpu" / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return tmp_path
+
+
+def _findings_with_cache(root, cache):
+    from flink_ml_tpu.analysis import engine as _engine
+    from flink_ml_tpu.analysis.engine import Project
+
+    project = Project.load(root=str(root), scope=("flink_ml_tpu",))
+    rule = _engine.get_rule("host-sync-leak")
+    report = _engine.run(
+        root=str(root), rules=[rule], project=project, summary_cache=cache
+    )
+    return sorted((f.path, f.line, f.rule) for f in report.findings)
+
+
+def test_cache_reverse_dependency_invalidation_keeps_parity(tmp_path):
+    """THE cache-vs-cold parity pin: warm the cache on a clean tree, edit
+    ONLY the helper so its summary changes, and the warm incremental run
+    must produce exactly the cold run's findings — including the
+    interprocedural finding in the UNCHANGED caller module, which only
+    appears if reverse-dependency invalidation re-analyzed it."""
+    from flink_ml_tpu.analysis import cache as cache_mod
+
+    root = _cache_tree(tmp_path, HELPER_CLEAN)
+    cache_file = str(tmp_path / ".tpulint_cache.json")
+
+    warm = cache_mod.SummaryCache.load(cache_file)
+    assert _findings_with_cache(root, warm) == []  # clean tree, cache warmed
+    assert os.path.exists(cache_file)
+
+    # the edit: helper gains a sync; caller.py is byte-identical
+    (root / "flink_ml_tpu" / "models" / "helper.py").write_text(
+        __import__("textwrap").dedent(HELPER_SYNCING)
+    )
+
+    cold = _findings_with_cache(root, None)
+    warm2 = cache_mod.SummaryCache.load(cache_file)
+    cached = _findings_with_cache(root, warm2)
+    assert cold == cached
+    # and the finding set is the interesting one: the unchanged caller
+    # carries the lifted finding; the helper's own param-sink is not a
+    # device-sourced finding
+    assert ("flink_ml_tpu/models/caller.py", 9, "host-sync-leak") in cold
+    # the dirty set was exactly the helper; the caller was invalidated by
+    # the reverse-import closure, everything else served from cache
+    assert warm2.dirty == {"flink_ml_tpu/models/helper.py"}
+    assert "flink_ml_tpu/models/caller.py" not in warm2.servable
+    assert "flink_ml_tpu/utils/lazyjit.py" in warm2.servable
+
+
+def test_cache_warm_full_run_identical_and_serving(tmp_path):
+    """Same tree, no edits: the warm run serves every analysis from the
+    cache and the findings are byte-identical."""
+    from flink_ml_tpu.analysis import cache as cache_mod
+
+    root = _cache_tree(tmp_path, HELPER_SYNCING)
+    cache_file = str(tmp_path / ".tpulint_cache.json")
+
+    cold = _findings_with_cache(root, cache_mod.SummaryCache.load(cache_file))
+    warm = cache_mod.SummaryCache.load(cache_file)
+    warmed = _findings_with_cache(root, warm)
+    assert cold == warmed != []
+    assert warm.dirty == set()
+    assert warm.hits > 0
+
+
+def test_cache_corrupt_file_treated_as_empty(tmp_path):
+    from flink_ml_tpu.analysis import cache as cache_mod
+
+    path = tmp_path / ".tpulint_cache.json"
+    path.write_text("{not json")
+    cache = cache_mod.SummaryCache.load(str(path))
+    assert cache.files == {}
+
+
+def test_cli_changed_cached_vs_cold_parity(tmp_path):
+    """End-to-end --changed parity: a git tree with a planted laundered
+    sync, cold (--no-cache) vs warmed cache runs emit identical JSON."""
+    import json as _json
+
+    root = _seed_tree(tmp_path, "models/bad.py", TWO_LAYER_LAUNDER)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GIT_AUTHOR_NAME": "t",
+        "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t",
+        "GIT_COMMITTER_EMAIL": "t@t",
+    }
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=str(root), check=True, capture_output=True, env=env
+        )
+
+    git("init", "-q")
+    git("add", "flink_ml_tpu/__init__.py", "flink_ml_tpu/utils")
+    git("commit", "-q", "-m", "seed")
+
+    cold = _run_cli(
+        "--root", str(root), "--changed", "--no-cache",
+        "--rule", "host-sync-leak", "--format", "json",
+    )
+    first = _run_cli(  # populates the cache
+        "--root", str(root), "--changed", "--rule", "host-sync-leak",
+        "--format", "json",
+    )
+    warm = _run_cli(  # serves from it
+        "--root", str(root), "--changed", "--rule", "host-sync-leak",
+        "--format", "json",
+    )
+    assert cold.returncode == first.returncode == warm.returncode == 1
+    payloads = [_json.loads(r.stdout) for r in (cold, first, warm)]
+    assert payloads[0] == payloads[1] == payloads[2]
+    assert payloads[0]["findings"], "the planted finding must survive caching"
+    assert "analyses served" in warm.stderr
+
+
+# ---------------------------------------------------------------------------
+# CLI: --format sarif
+# ---------------------------------------------------------------------------
+
+def test_format_sarif_findings_and_rule_metadata(tmp_path):
+    import json as _json
+
+    root = _seed_tree(tmp_path, "models/bad.py", TWO_LAYER_LAUNDER)
+    result = _run_cli(
+        "--root", str(root), "--rule", "host-sync-leak", "--format", "sarif"
+    )
+    assert result.returncode == 1, result.stdout + result.stderr
+    payload = _json.loads(result.stdout)
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"host-sync-leak", "mesh-axis", "spec-consistency"} <= rule_ids
+    unsuppressed = [r for r in run["results"] if "suppressions" not in r]
+    (finding,) = unsuppressed
+    assert finding["ruleId"] == "host-sync-leak"
+    loc = finding["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "flink_ml_tpu/models/bad.py"
+    assert loc["region"]["startLine"] == 16
+
+
+def test_format_sarif_clean_tree_exit_zero(tmp_path):
+    import json as _json
+
+    root = _seed_tree(tmp_path, "models/ok.py", "x = 1\n")
+    result = _run_cli(
+        "--root", str(root), "--rule", "host-sync-leak", "--format", "sarif"
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    payload = _json.loads(result.stdout)
+    assert payload["runs"][0]["results"] == []

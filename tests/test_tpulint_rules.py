@@ -1340,3 +1340,529 @@ class TestHotSwapPublishIdioms:
             "models/__init__.py": "",
         }, ["lock-order", "channel-protocol"])
         assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# tpulint v3: the SPMD mesh/axis verifier (analysis/spmd.py)
+# ---------------------------------------------------------------------------
+
+#: minimal mesh + collectives pair the SPMD layer anchors on (same relative
+#: paths as the real package: parallel/mesh.py declares the *_AXIS
+#: constants, parallel/collectives.py the accounted wrappers)
+SPMD_STUB = {
+    "parallel/__init__.py": "",
+    "parallel/mesh.py": """
+        DATA_AXIS = "data"
+        MODEL_AXIS = "model"
+
+        def create_mesh(axis_names=(DATA_AXIS,), shape=None, devices=None):
+            pass
+    """,
+    "parallel/collectives.py": """
+        from jax import lax
+
+        from .mesh import DATA_AXIS, MODEL_AXIS
+
+        def all_reduce_sum(x, axis_name=DATA_AXIS):
+            return lax.psum(x, axis_name)
+
+        def all_reduce_min(x, axis_name=DATA_AXIS):
+            return lax.pmin(x, axis_name)
+
+        def all_gather(x, axis_name=DATA_AXIS, axis=0, tiled=True):
+            return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+        def ppermute_ring(x, axis_name=DATA_AXIS, shift=1):
+            return lax.ppermute(x, axis_name, [(0, 0)])
+
+        def axis_index(axis_name=DATA_AXIS):
+            return lax.axis_index(axis_name)
+
+        def axis_size(axis_name=DATA_AXIS):
+            return 1
+
+        def shard_map_over(mesh, in_specs, out_specs, fn=None, check_vma=False):
+            return fn
+    """,
+}
+
+
+class TestMeshAxis:
+    def test_true_positive_unknown_axis_literal(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from ..parallel.collectives import all_reduce_sum
+
+                def reduce(x):
+                    return all_reduce_sum(x, "dta")
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["mesh-axis"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data == ("unknown-axis", "dta")
+        assert f.path == "flink_ml_tpu/models/bad.py" and f.line == 5
+
+    def test_true_positive_constant_bypass_literal(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+
+                def reduce(x):
+                    return collectives.all_reduce_sum(x, "data")
+
+                def spec():
+                    return P("model", None)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["mesh-axis"])
+        kinds = sorted((f.data[0], f.data[1]) for f in report.findings)
+        assert kinds == [("axis-bypass", "data"), ("axis-bypass", "model")]
+        assert "DATA_AXIS" in report.findings[0].message
+
+    def test_true_positive_gather_over_unsharded_axis(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        return collectives.all_gather(x, MODEL_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(DATA_AXIS), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["mesh-axis"])
+        assert [f.data[0] for f in report.findings] == ["unsharded-collective"]
+        assert report.findings[0].data[2] == "model"
+
+    def test_true_negative_constants_and_known_axes(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.collectives import DATA_AXIS, all_reduce_sum
+                from ..parallel.mesh import MODEL_AXIS
+
+                def reduce(x):
+                    return all_reduce_sum(x, DATA_AXIS)
+
+                def reduce_feature(x):
+                    return collectives.all_reduce_sum(x, axis_name=MODEL_AXIS)
+
+                def spec():
+                    return P(DATA_AXIS, MODEL_AXIS)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["mesh-axis"])
+        assert report.findings == []
+
+    def test_suppression_hides_and_unused_is_reported(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/mixed.py": """
+                from ..parallel.collectives import all_reduce_sum
+
+                def reduce(x):
+                    # tpulint: disable=mesh-axis -- exercising a foreign mesh in a compat shim
+                    return all_reduce_sum(x, "data")
+
+                def clean(x):
+                    # tpulint: disable=mesh-axis -- nothing here
+                    return x
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["mesh-axis"])
+        assert [f.rule for f in report.findings] == ["unused-suppression"]
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].data[0] == "axis-bypass"
+
+
+class TestCollectiveDivergence:
+    def test_true_positive_axis_index_branch(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        i = collectives.axis_index(DATA_AXIS)
+                        if i == 0:
+                            x = collectives.all_reduce_sum(x, DATA_AXIS)
+                        return x
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(DATA_AXIS), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["collective-divergence"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data[0] == "divergent" and f.data[1] == "all_reduce_sum"
+        assert f.line == 10  # the collective, not the branch
+        assert "line 9" in f.message  # ... which is named in the message
+
+    def test_true_positive_data_dependent_branch(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        if jnp.sum(x) > 0:
+                            x = collectives.all_gather(x, DATA_AXIS)
+                        return x
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(DATA_AXIS), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["collective-divergence"])
+        assert [f.data[0] for f in report.findings] == ["divergent"]
+
+    def test_true_negative_uniform_branch_and_masked_contribution(self, tmp_path):
+        # branch on a REDUCED (uniform) value, collective outside any
+        # branch, contribution masked — the sanctioned shape
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh, epochs):
+                    def body(x):
+                        total = collectives.all_reduce_sum(jnp.sum(x), DATA_AXIS)
+                        if total > 0:
+                            scale = 2.0
+                        else:
+                            scale = 1.0
+                        if epochs > 1:
+                            scale = scale + 1.0
+                        mask = x > 0
+                        return collectives.all_reduce_sum(
+                            jnp.where(mask, x, 0.0), DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["collective-divergence"])
+        assert report.findings == []
+
+    def test_suppression_hides_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/mixed.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        i = collectives.axis_index(DATA_AXIS)
+                        if i == 0:
+                            # tpulint: disable=collective-divergence -- single-host probe, documented
+                            x = collectives.all_reduce_sum(x, DATA_AXIS)
+                        return x
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(DATA_AXIS), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["collective-divergence"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+
+class TestSpecConsistency:
+    def test_true_positive_replicated_output_never_reduced(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        return x * 2.0
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data[0] == "unreduced-output"
+        assert "data" in f.message
+
+    def test_true_positive_double_reduce(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        y = collectives.all_reduce_sum(x, DATA_AXIS)
+                        return collectives.all_reduce_sum(y, DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert [f.data[0] for f in report.findings] == ["double-reduce"]
+        assert report.findings[0].data[2] == "data"
+
+    def test_true_positive_spec_arity_mismatch(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x, y):
+                        return collectives.all_reduce_sum(x + y, DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert [f.data[0] for f in report.findings] == ["spec-arity"]
+
+    def test_true_negative_reduced_output_and_carry_loop(self, tmp_path):
+        # the overlap.py shape in miniature: sharded batch, carry-delayed
+        # reduce through a lax.while_loop, replicated result
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                from jax import lax
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    axis = DATA_AXIS
+
+                    def body(X, coeff):
+                        def cond(state):
+                            c, g, epoch = state
+                            return epoch < 3
+
+                        def step(state):
+                            c, g, epoch = state
+                            c = c - collectives.all_reduce_sum(g, axis)
+                            g = X.T @ (X @ c)
+                            return (c, g, epoch + 1)
+
+                        init = (coeff, jnp.zeros_like(coeff), 0)
+                        c, g, _ = lax.while_loop(cond, step, init)
+                        return c - collectives.all_reduce_sum(g, axis)
+
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS, None), P()), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert report.findings == []
+
+    def test_unknown_specs_suppress_findings(self, tmp_path):
+        # unresolvable in_specs: the engine must stay quiet, not guess
+        report = _run(tmp_path, {
+            "models/opaque.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+
+                def build(mesh, in_specs):
+                    def body(x):
+                        return x
+                    return collectives.shard_map_over(mesh, in_specs, P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert report.findings == []
+
+    def test_suppression_hides_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/mixed.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        # tpulint: disable=spec-consistency -- shard 0's value IS the result here, documented
+                        return x * 2.0
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+
+class TestPrecisionDeterminism:
+    def test_true_positive_downcast_before_reduce(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        return collectives.all_reduce_sum(
+                            x.astype(jnp.bfloat16), DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data == ("downcast", "all_reduce_sum", "bfloat16")
+
+    def test_true_positive_downcast_through_assignment(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        small = x.astype(jnp.float16)
+                        return collectives.all_reduce_sum(small, DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert [f.data[0] for f in report.findings] == ["downcast"]
+        assert report.findings[0].data[2] == "float16"
+
+    def test_true_negative_f32_accumulator_cast(self, tmp_path):
+        # the overlap.py tol-check shape: astype(jnp.float32) on the two
+        # scalars is a WIDENING (or no-op) cast and must stay legal
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        return collectives.all_reduce_sum(
+                            jnp.sum(x).astype(jnp.float32), DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert report.findings == []
+
+    def test_true_positive_manual_ring_fold_outside_sanctioned(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def fold(x, n):
+                    acc = x
+                    for _ in range(n - 1):
+                        x = collectives.ppermute_ring(x, DATA_AXIS)
+                        acc = acc + x
+                    return acc
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert [f.data[0] for f in report.findings] == ["order-fold"]
+
+    def test_true_negative_fold_inside_collectives_is_sanctioned(self, tmp_path):
+        # the same fold INSIDE parallel/collectives.py is the sanctioned
+        # replica-order implementation
+        stub = dict(SPMD_STUB)
+        stub["parallel/collectives.py"] = stub["parallel/collectives.py"] + (
+            "\n"
+            "def ring_fold(x, n, axis_name=DATA_AXIS):\n"
+            "    acc = x\n"
+            "    for _ in range(n - 1):\n"
+            "        x = ppermute_ring(x, axis_name)\n"
+            "        acc = acc + x\n"
+            "    return acc\n"
+        )
+        report = _run(tmp_path, {
+            **stub,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert report.findings == []
+
+    def test_suppression_hides_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/mixed.py": """
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def body(x):
+                        # tpulint: disable=precision-determinism -- wire-format bf16, error budget documented
+                        return collectives.all_reduce_sum(
+                            x.astype(jnp.bfloat16), DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["precision-determinism"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
