@@ -202,14 +202,39 @@ def bench_logreg_amortized(num_rows, max_iter=200, in_budget=lambda: True):
     train loop's own throughput. trainedExamplesPerSec counts SGD work
     actually done (batch records x epochs per second); epochMsAmortized is
     the per-epoch cost once the fixed floor is spread thin."""
+    from flink_ml_tpu.obs import timeline
+    from flink_ml_tpu.utils import metrics
+
     runs = []
+    last_attr = None
+    last_dispatch_ms = 0.0
     for i in range(3):
         if i > 0 and len(runs) > 1 and not in_budget():
             break
+        # flight-record the warm runs: the per-fit dispatch-wall
+        # attribution (wall = dispatch + device + readback + idle-gap)
+        # is the item-2 evidence next to the throughput number
+        if i > 0:
+            timeline.configure(ring_size=16384)
+        mark_us = timeline.now_us()
+        before = metrics.snapshot()
         t0 = time.perf_counter()
         table = _gen_table(num_rows, seed=2 + i)
         _make_logreg(num_rows, max_iter=max_iter).fit(table)
         runs.append(time.perf_counter() - t0)
+        if i > 0:
+            events, _ = timeline.snapshot_events()
+            attr = timeline.dispatch_attribution(
+                [e for e in events if e["tsUs"] >= mark_us]
+            )
+            if attr:
+                attr.pop("chunks", None)
+                last_attr = attr
+            delta = metrics.snapshot_delta(before, metrics.snapshot())
+            last_dispatch_ms = delta["timers"].get("iteration.dispatch", {}).get(
+                "totalMs", 0.0
+            )
+            timeline.configure()
         log(
             f"logreg maxIter={max_iter} run {i}: {runs[-1] * 1000:.0f} ms"
             + (" (cold: includes compile)" if i == 0 else "")
@@ -223,6 +248,12 @@ def bench_logreg_amortized(num_rows, max_iter=200, in_budget=lambda: True):
         "inputThroughput": num_rows / warm,
         "trainedExamplesPerSec": min(BATCH, num_rows) * max_iter / warm,
         "epochMsAmortized": warm * 1000.0 / max_iter,
+        # host-side dispatch time of the LAST warm fit and its residual
+        # gap (device + readback + idle): the measurable form of the
+        # "wall is tunnel-dispatch+readback" verdict, per run
+        "hostDispatchMs": last_dispatch_ms,
+        "dispatchGapMs": max(0.0, runs[-1] * 1000.0 - last_dispatch_ms),
+        "dispatchAttribution": last_attr,
     }
 
 
@@ -873,6 +904,10 @@ def bench_overload_soak(num_requests=60, batch_rows=256, d=24):
         "submitted": submitted,
         "rejected": rejected,
         "completed": len(results),
+        # the SLO surface (ISSUE 12): per-stage latency percentiles from
+        # the obs/hist.py histograms, via ServerHealth — queue-wait vs
+        # batch-form vs dispatch vs readback, p50/p90/p99/p999 each
+        "stageLatencyMs": health.stageLatencyMs,
         "admissionCapacity": server.admission,
         "inFlight": server.in_flight,
         "peakAdmissionDepth": int(peak_admit),

@@ -76,8 +76,11 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
     carries its own evidence for perf claims."""
     from contextlib import contextmanager
 
+    from ..obs import timeline
+
     tracing.install_jax_hooks()
     metrics_before = metrics.snapshot()
+    timeline_start_us = timeline.now_us()
     phases: Dict[str, float] = {}
 
     @contextmanager
@@ -136,6 +139,17 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
     elapsed_ms = (time.perf_counter() - start) * 1000.0
 
     delta = metrics.snapshot_delta(metrics_before, metrics.snapshot())
+    # dispatch-wall attribution (obs/timeline.py): the work phases' wall
+    # split into host-dispatch time (the `iteration.dispatch` funnel —
+    # every chunk/fused-program launch rides it) and the GAP the host was
+    # not dispatching: device execution + readback + tunnel/idle latency.
+    # `dispatchGapMs ~ wallMs - hostDispatchMs` is THE item-2 progress
+    # metric: the resident-program work must grow hostDispatch's share of
+    # a shrinking wall. gapCount = dispatch->drain cycles (one per chunk).
+    work_ms = (phases.get("fit", 0.0) + phases.get("transform", 0.0)) * 1000.0
+    disp_timer = delta["timers"].get("iteration.dispatch", {})
+    host_dispatch_ms = float(disp_timer.get("totalMs", 0.0))
+    gap_count = int(disp_timer.get("count", 0))
     return {
         "name": name,
         "totalTimeMs": elapsed_ms,
@@ -150,6 +164,11 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # between BENCH files is a dispatch regression
         "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
         "dispatchDepth": int(delta["gauges"].get("iteration.dispatch_depth", 0)),
+        "hostDispatchMs": host_dispatch_ms,
+        "dispatchGapMs": (
+            max(0.0, work_ms - host_dispatch_ms) if gap_count else 0.0
+        ),
+        "gapCount": gap_count,
         # segments the transform phase fused (0 = eager per-stage path); a
         # drop between BENCH files means stages fell off the fused path
         "fusedSegments": int(delta["gauges"].get("pipeline.fused_segments", 0)),
@@ -190,8 +209,28 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # sparse-vs-dense byte ratio when a sparse reduce ran) — the
         # traffic-proportionality evidence next to the timing numbers
         "collectiveBreakdown": collective_breakdown(delta),
+        # per-chunk timeline attribution when the flight recorder is on
+        # (wall = dispatch + device + readback + idle-gap, obs/timeline.py)
+        "dispatchAttribution": _entry_attribution(timeline, timeline_start_us),
         "metrics": delta,
     }
+
+
+def _entry_attribution(timeline, start_us: float) -> Optional[Dict]:
+    """This entry's dispatch-wall attribution from the flight recorder
+    (events recorded since `start_us`); None when the timeline is off or
+    no chunk dispatch ran. The per-chunk rows are dropped from the BENCH
+    payload (unbounded size) — totals + per-epoch means stay."""
+    if not timeline.enabled():
+        return None
+    events, _ = timeline.snapshot_events()
+    attr = timeline.dispatch_attribution(
+        [e for e in events if e["tsUs"] >= start_us]
+    )
+    if not attr:
+        return None
+    attr.pop("chunks", None)
+    return attr
 
 
 def collective_breakdown(delta: Dict) -> Dict[str, Dict]:

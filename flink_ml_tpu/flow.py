@@ -79,6 +79,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
+from .obs import timeline
 from .utils import metrics
 
 __all__ = [
@@ -209,10 +210,20 @@ class BoundedChannel:
                     self.stats.rejected += 1
                     metrics.inc_counter("flow.reject")
                     metrics.inc_counter(f"flow.reject.{self.name}")
+                    if timeline.enabled():
+                        timeline.record_instant(
+                            timeline.LANE_FLOW,
+                            f"{self.name}.reject",
+                            depth=len(self._items),
+                        )
                     raise ChannelRejected(self.name, len(self._items), self.capacity)
                 self.stats.shed += 1
                 metrics.inc_counter("flow.shed")
                 metrics.inc_counter(f"flow.shed.{self.name}")
+                if timeline.enabled():
+                    timeline.record_instant(
+                        timeline.LANE_FLOW, f"{self.name}.shed", depth=len(self._items)
+                    )
                 if self.policy == SAMPLE:  # keep the queue: a prefix sample
                     self._seq += 1  # the dropped item still "happened"
                     return False
@@ -221,6 +232,10 @@ class BoundedChannel:
             self._seq += 1
             self.stats.puts += 1
             self._note_depth(len(self._items))
+            if timeline.enabled():
+                timeline.record_instant(
+                    timeline.LANE_FLOW, f"{self.name}.put", depth=len(self._items)
+                )
             self._cv.notify_all()
             return True
 
@@ -237,6 +252,10 @@ class BoundedChannel:
             self._seq += 1
             self.stats.puts += 1
             self._note_depth(len(self._items))
+            if timeline.enabled():
+                timeline.record_instant(
+                    timeline.LANE_FLOW, f"{self.name}.put", depth=len(self._items)
+                )
             self._cv.notify_all()
             return True
 
@@ -273,6 +292,13 @@ class BoundedChannel:
             if lag > self.stats.max_lag:
                 self.stats.max_lag = lag
             metrics.set_gauge(f"flow.lag.{self.name}", lag)
+            if timeline.enabled():
+                timeline.record_instant(
+                    timeline.LANE_FLOW,
+                    f"{self.name}.get",
+                    depth=len(self._items),
+                    lag=lag,
+                )
             self._cv.notify_all()
             return item
 
@@ -433,6 +459,13 @@ def with_retries(
             metrics.inc_counter("flow.retry")
             if site:
                 metrics.inc_counter(f"flow.retry.{site}")
+            if timeline.enabled():
+                timeline.record_instant(
+                    timeline.LANE_FLOW,
+                    f"retry.{site or 'unsited'}",
+                    attempt=attempt,
+                    error=type(e).__name__,
+                )
             if on_retry is not None:
                 on_retry(e, attempt)
             delay = min(cap, base * (2 ** (attempt - 1)))

@@ -62,6 +62,7 @@ import numpy as np
 from . import config
 from .api import KernelContext, Model
 from .ckpt import faults
+from .obs import timeline
 from .utils import metrics
 
 __all__ = [
@@ -308,6 +309,10 @@ class ModelLifecycle:
         self.swap_count += 1
         metrics.inc_counter("lifecycle.swap")
         metrics.set_gauge("lifecycle.publishedVersion", version_id)
+        if timeline.enabled():
+            timeline.record_instant(
+                timeline.LANE_LIFECYCLE, "lifecycle.promote", version=version_id
+            )
         self._event("promoted", version_id)
         return entry
 
@@ -331,6 +336,13 @@ class ModelLifecycle:
         self.rollback_count += 1
         self._outcomes.clear()
         metrics.inc_counter("lifecycle.rollback")
+        if timeline.enabled():
+            timeline.record_instant(
+                timeline.LANE_LIFECYCLE,
+                "lifecycle.rollback",
+                version=target.version_id,
+                fromVersion=bad,
+            )
         metrics.set_gauge("lifecycle.publishedVersion", target.version_id)
         self._event("rollback", target.version_id, f"from {bad}: {reason}")
         self._quarantined = TrainerQuarantined(bad, reason)

@@ -309,12 +309,14 @@ class KMeans(Estimator, KMeansParams):
         with tracing.span(
             "iteration.run", mode="device", epochs=self.get_max_iter()
         ):
-            centroids, counts = train(
+            centroids, counts = dispatch.timed_dispatch(
+                train,
                 X_dev,
                 w_dev,
                 init_centroids,
                 jnp.asarray(self.get_max_iter(), jnp.int32),
                 self.get_distance_measure(),
+                start=0, end=self.get_max_iter(),
             )
 
             model = KMeansModel()

@@ -14,13 +14,23 @@ Three layers:
   (`FLINK_ML_TPU_TRACE_RING`), and aggregated into `metrics.snapshot()`.
   The no-op path (no sink configured) is a shared singleton context
   manager — cheap enough to stay always-on.
-- `exporters` — render `metrics.snapshot()` as JSON or Prometheus text.
+- `timeline` — the flight recorder: a bounded lock-cheap ring of
+  begin/end events (`FLINK_ML_TPU_TIMELINE_RING` /
+  `FLINK_ML_TPU_TIMELINE_FILE`) with thread + logical-stream lanes,
+  exported as Chrome/Perfetto trace-event JSON
+  (`scripts/obs_timeline.py`) and reduced to per-chunk dispatch-wall
+  attribution (`wall = dispatch + device + readback + idle-gap`).
+- `hist` — mergeable log2-bucketed streaming histograms
+  (p50/p90/p99/p999, fixed memory) for SLO latency/size distributions.
+- `exporters` — render `metrics.snapshot()` (and the histogram
+  registry) as JSON or Prometheus text, with a name-collision check.
 - `report` — reduce a JSONL trace to per-stage / per-epoch time-breakdown
   tables with category accounting (see `scripts/obs_report.py`).
 
 See docs/observability.md for the full surface and a worked example.
 """
 
+from . import hist, timeline  # noqa: F401
 from .tracing import (  # noqa: F401
     account_host_sync,
     add_attr,

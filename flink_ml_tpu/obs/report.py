@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import json
 from collections import OrderedDict
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 CATEGORIES = ("collective", "readback", "compile", "cache")
 _STAGE_NAMES = ("pipeline.stage", "stage.fit", "stage.transform")
@@ -43,11 +43,80 @@ def load_trace(path: str) -> List[Dict]:
     return records
 
 
+def sanitize_records(records: Iterable[Dict]) -> "Tuple[List[Dict], int]":
+    """Normalize a possibly ring-truncated / mid-span-truncated record
+    stream into well-formed span records: timeline-style begin/end events
+    (`ph` B/E) are paired into spans, complete/instant timeline events
+    become spans, and records missing the span schema are dropped.
+    Returns (clean records, dropped count) — dropped counts unmatched
+    begins/ends (their partner fell off the ring or the file was cut
+    mid-span) plus unrecognizable records. Never raises."""
+    clean: List[Dict] = []
+    dropped = 0
+    open_begins: Dict[object, Dict] = {}
+    synth_id = -1  # synthesized span ids stay clear of real ones
+    for r in records:
+        if not isinstance(r, dict):
+            dropped += 1
+            continue
+        ph = r.get("ph")
+        if ph == "B":
+            open_begins[(r.get("lane"), r.get("ref"), r.get("name"))] = r
+            continue
+        if ph == "E":
+            begin = open_begins.pop((r.get("lane"), r.get("ref"), r.get("name")), None)
+            if begin is None:
+                dropped += 1  # begin fell off the ring
+                continue
+            clean.append(
+                {
+                    "name": r.get("name", "?"),
+                    "spanId": r.get("ref") if r.get("ref") is not None else synth_id,
+                    "parentId": 0,
+                    "startUs": float(begin.get("tsUs", 0.0)),
+                    "durUs": max(
+                        0.0, float(r.get("tsUs", 0.0)) - float(begin.get("tsUs", 0.0))
+                    ),
+                    "attrs": r.get("args") or {},
+                }
+            )
+            synth_id -= 1
+            continue
+        if ph in ("X", "i"):
+            clean.append(
+                {
+                    "name": r.get("name", "?"),
+                    "spanId": synth_id,
+                    "parentId": 0,
+                    "startUs": float(r.get("tsUs", 0.0)),
+                    "durUs": float(r.get("durUs", 0.0)),
+                    "attrs": r.get("args") or {},
+                }
+            )
+            synth_id -= 1
+            continue
+        if "name" in r and "spanId" in r:
+            r.setdefault("parentId", 0)
+            r.setdefault("startUs", 0.0)
+            r.setdefault("durUs", 0.0)
+            r.setdefault("attrs", {})
+            clean.append(r)
+            continue
+        dropped += 1
+    dropped += len(open_begins)  # ends lost to truncation
+    return clean, dropped
+
+
 class Trace:
     """Indexed view of a span list: parent/child links + category sums."""
 
     def __init__(self, records: Iterable[Dict]):
-        self.records = list(records)
+        # defensively span-shaped only: callers SHOULD sanitize first
+        # (sanitize_records), but a stray malformed record must degrade
+        # to "skipped", not a KeyError ten frames down
+        self.records = [
+            r for r in records if isinstance(r, dict) and "spanId" in r
+        ]
         self.by_id = {r["spanId"]: r for r in self.records}
         self.children: Dict[int, List[Dict]] = {}
         for r in self.records:

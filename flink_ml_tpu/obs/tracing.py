@@ -35,6 +35,7 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from ..utils import metrics
+from . import timeline
 
 # Monotonic origin for startUs: perf_counter_ns at import. JSONL consumers
 # only need ordering + durations, not wall-clock identity.
@@ -53,25 +54,36 @@ _enabled = False  # fast-path flag: True iff a sink is configured
 
 
 def enabled() -> bool:
-    """True when a trace sink (file or ring) is configured."""
+    """True when a trace sink (file, ring, or the timeline flight
+    recorder) is configured."""
     return _enabled
+
+
+def _refresh_enabled() -> None:
+    """Recompute the span fast-path flag; the timeline flight recorder
+    counts as a sink (timeline.configure calls this)."""
+    global _enabled
+    _enabled = (
+        _trace_path is not None or _ring is not None or timeline.enabled()
+    )
+    if _enabled:
+        install_jax_hooks()
 
 
 def configure(
     trace_file: Optional[str] = None, ring_size: Optional[int] = None
 ) -> None:
     """(Re)configure the process-wide trace sinks. `None`/0 for both
-    disables tracing entirely (the no-op fast path)."""
-    global _trace_path, _trace_file, _ring, _enabled
+    disables tracing entirely (the no-op fast path — unless the timeline
+    flight recorder is configured, which keeps spans flowing)."""
+    global _trace_path, _trace_file, _ring
     with _lock:
         if _trace_file is not None:
             _trace_file.close()
             _trace_file = None
         _trace_path = trace_file or None
         _ring = deque(maxlen=int(ring_size)) if ring_size else None
-        _enabled = _trace_path is not None or _ring is not None
-    if _enabled:
-        install_jax_hooks()
+    _refresh_enabled()
 
 
 def _init_from_env() -> None:
@@ -139,6 +151,8 @@ class Span:
         self.parent_id = parent.span_id if parent is not None else 0
         self.span_id = next(_ids)
         self._token = _current.set(self)
+        if timeline.enabled():  # flight recorder: a live begin mark
+            timeline.record_begin(timeline.host_lane(), self.name, ref=self.span_id)
         self._start_ns = time.perf_counter_ns()
         return self
 
@@ -149,6 +163,10 @@ class Span:
             self.attrs["error"] = exc_type.__name__
         dur_ns = end_ns - self._start_ns
         metrics.record_time("span." + self.name, dur_ns / 1e9)
+        if timeline.enabled():
+            timeline.record_end(
+                timeline.host_lane(), self.name, ref=self.span_id, **self.attrs
+            )
         _emit(
             {
                 "name": self.name,
@@ -231,6 +249,16 @@ def account_readback(nbytes: int, seconds: float, arrays: int = 1) -> None:
     metrics.inc_counter("readback.count")
     metrics.inc_counter("readback.bytes", int(nbytes))
     metrics.record_time("readback", seconds)
+    if timeline.enabled():
+        end_ns = time.perf_counter_ns()
+        timeline.record_complete(
+            timeline.LANE_READBACK,
+            "readback",
+            end_ns - int(seconds * 1e9),
+            int(seconds * 1e9),
+            bytes=int(nbytes),
+            arrays=arrays,
+        )
     if _enabled:
         emit_completed(
             "readback",
@@ -272,6 +300,10 @@ def account_collective(
             metrics.get_counter("collective.sparse.bytes")
             / max(metrics.get_counter("collective.sparse.dense_equiv_bytes"), 1),
         )
+    if timeline.enabled():
+        timeline.record_instant(
+            timeline.LANE_COLLECTIVE, f"collective.{op}", bytes=int(nbytes), axis=axis
+        )
     if _enabled:
         attrs = dict(category="collective", bytes=int(nbytes), chunks=int(chunks), axis=axis)
         if dense_equiv_bytes:
@@ -288,6 +320,8 @@ def account_host_sync(kind: str = "drain", count: int = 1) -> None:
     O(maxIter/K) is visible as a counter jump in any BENCH delta."""
     metrics.inc_counter("iteration.host_sync", count)
     metrics.inc_counter(f"iteration.host_sync.{kind}", count)
+    if timeline.enabled():
+        timeline.record_instant(timeline.host_lane(), f"host_sync.{kind}")
 
 
 def set_dispatch_depth(depth: int) -> None:

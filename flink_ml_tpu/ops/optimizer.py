@@ -520,11 +520,14 @@ class SGD:
         # the model length is the feature dim — X may be sparse (indices,
         # values), whose second axis is the nnz width, not the dim
         d = int(np.shape(init_coeff)[0])
+        from ..parallel import dispatch
+
         if self._overlap_enabled():
             from ..parallel import overlap
 
             X_b, y_b, w_b = self._batchify(mesh, X, y, weights)
-            packed = overlap.overlapped_sgd_train(
+            packed = dispatch.timed_dispatch(
+                overlap.overlapped_sgd_train,
                 mesh,
                 X_b,
                 y_b,
@@ -533,6 +536,7 @@ class SGD:
                 loss_func,
                 self._hyper(),
                 validate_labels,
+                start=0, end=self.max_iter,
             )
             return ("packed", packed, d, validate_labels)
         if (
@@ -565,7 +569,8 @@ class SGD:
             if validate_labels:
                 flag = float(jax.device_get(_binomial_labels_ok(y_b)))
             return ("host", coeff, criteria, epochs, flag, d)
-        packed = _sgd_train(
+        packed = dispatch.timed_dispatch(
+            _sgd_train,
             X_b,
             y_b,
             w_b,
@@ -574,6 +579,7 @@ class SGD:
             self._hyper(),
             validate_labels,
             self._pack_sharding(mesh),
+            start=0, end=self.max_iter,
         )
         return ("packed", packed, d, validate_labels)
 
@@ -780,8 +786,9 @@ class SGD:
                         if (donate_next and donate_ok)
                         else _stream_epoch
                     )
-                    carry, crit_dev, packed = step(
-                        *batch_dev, carry, crit_dev, loss_func, hyper
+                    carry, crit_dev, packed = dispatch.timed_dispatch(
+                        step, *batch_dev, carry, crit_dev, loss_func, hyper,
+                        start=planned, end=planned + 1,
                     )
                 handle(
                     queue.push(
@@ -859,7 +866,10 @@ class SGD:
         has_weights = w_f is not None
         if not has_weights:
             w_f = jnp.zeros((0,), self.dtype)
-        return _sgd_train_flat(
+        from ..parallel import dispatch
+
+        return dispatch.timed_dispatch(
+            _sgd_train_flat,
             X_f,
             y_f,
             w_f,
@@ -870,6 +880,7 @@ class SGD:
             jnp.asarray(n, jnp.int32),
             self._hyper(),
             validate_labels,
+            start=0, end=self.max_iter,
         )
 
     def _optimize_with_checkpoints(self, X_b, y_b, w_b, init_coeff, loss_func, mesh):
@@ -974,9 +985,11 @@ class SGD:
                     _sgd_chunk_donating if (donate_next and donate_ok) else _sgd_chunk
                 )
                 with tracing.span("iteration.chunk", epoch=planned, end=end):
-                    carry, crit_dev, packed = step(
+                    carry, crit_dev, packed = dispatch.timed_dispatch(
+                        step,
                         X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
                         jnp.asarray(end, jnp.int32),
+                        start=planned, end=end,
                     )
                 handle(
                     queue.push(

@@ -38,7 +38,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analysis import sanitizer as _sanitizer
-from ..obs import tracing
+from ..obs import hist, tracing
 from ..utils import metrics
 
 # the axis-name constants are DECLARED in mesh.py and re-exported here so
@@ -97,6 +97,10 @@ def _account(op: str, x, axis_name: str, chunks: int = None, dense_equiv_bytes: 
         )
     else:
         _sanitizer.record_collective(op, axis_name, (), "none")
+    # payload-SIZE distribution (SparCML-style evaluation: per-collective
+    # size histograms, not just byte sums — a p99 payload far above p50
+    # says the bucketing layer is emitting stragglers)
+    hist.record("collective.payloadBytes", nbytes)
     tracing.account_collective(
         op,
         nbytes,

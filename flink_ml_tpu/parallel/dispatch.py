@@ -47,7 +47,8 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from ..obs import tracing
+from ..obs import hist, timeline, tracing
+from ..utils import metrics
 
 
 def supports_donation() -> bool:
@@ -124,6 +125,33 @@ def clear_runner_cache() -> None:
     _runner_cache.clear()
 
 
+def timed_dispatch(step: Callable, *args, start: int = None, end: int = None):
+    """THE accounted chunk-dispatch funnel: every chunk program launch in
+    the iteration runtime rides through here, so the host-side dispatch
+    cost is one timer (`iteration.dispatch` — the `hostDispatchMs` BENCH
+    field) and one timeline `dispatch`-lane event, and the dispatch-wall
+    attribution (`obs.timeline.dispatch_attribution`) can split every
+    fit's wall into dispatch + device + readback + idle-gap. On an async
+    backend this times the enqueue; on CPU, the synchronous execution —
+    either way it is exactly the time the host thread was captive to the
+    launch. `start`/`end` are the chunk's planned epoch range (drives the
+    per-epoch attribution)."""
+    t0 = time.perf_counter_ns()
+    out = step(*args)
+    dur_ns = time.perf_counter_ns() - t0
+    metrics.record_time("iteration.dispatch", dur_ns / 1e9)
+    if timeline.enabled():
+        attrs = {}
+        if start is not None:
+            attrs["start"] = int(start)
+        if end is not None:
+            attrs["end"] = int(end)
+        timeline.record_complete(
+            timeline.LANE_DISPATCH, "dispatch.chunk", t0, dur_ns, **attrs
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # bounded-depth drain queue
 # ---------------------------------------------------------------------------
@@ -155,7 +183,11 @@ class DrainQueue:
     def push(self, entry: InFlight) -> List[Tuple[InFlight, int, float]]:
         """Queue a dispatched chunk; returns the drained (entry, epoch,
         criteria) records (empty while the queue is under its depth)."""
-        self._q.append(entry)
+        self._q.append((entry, time.perf_counter_ns()))
+        if timeline.enabled():  # the dispatch window is a flow channel too
+            timeline.record_instant(
+                timeline.LANE_FLOW, "drainqueue.push", depth=len(self._q)
+            )
         drained = []
         while len(self._q) > self.depth:
             drained.append(self._drain_one())
@@ -170,11 +202,29 @@ class DrainQueue:
     def _drain_one(self) -> Tuple[InFlight, int, float]:
         import jax
 
-        entry = self._q.popleft()
+        entry, pushed_ns = self._q.popleft()
+        t0_ns = time.perf_counter_ns()
         t0 = time.perf_counter()
         host = np.asarray(jax.device_get(entry.packed))
         tracing.account_host_sync("drain")
         tracing.account_readback(host.nbytes, time.perf_counter() - t0)
+        end_ns = time.perf_counter_ns()
+        # chunk wall: dispatch push -> drained scalar on host, the
+        # per-chunk latency distribution of the dispatch pipeline
+        hist.record("iteration.chunkWallMs", (end_ns - pushed_ns) / 1e6)
+        if timeline.enabled():
+            # estimated device-execution interval: dispatch end to the
+            # blocking readback start (exact on a synchronous backend,
+            # an upper bound under async dispatch — the drain may also
+            # have waited on still-running device work)
+            timeline.record_complete(
+                timeline.LANE_DEVICE,
+                "device.chunk(est)",
+                pushed_ns,
+                max(0, t0_ns - pushed_ns),
+                start=entry.start,
+                end=entry.end,
+            )
         return entry, int(host[0]), float(host[1])
 
 

@@ -323,9 +323,11 @@ def _iterate_host_driven(
                 **({} if per_epoch else {"end": end}),
             ):
                 with metrics.timed("iteration.epoch" if per_epoch else "iteration.chunk"):
-                    carry, epoch_dev, crit_dev, packed = step(
+                    carry, epoch_dev, crit_dev, packed = dispatch.timed_dispatch(
+                        step,
                         carry, epoch_dev, crit_dev,
                         jnp.asarray(end, jnp.int32), tol_value,
+                        start=planned, end=end,
                     )
             handle(
                 queue.push(

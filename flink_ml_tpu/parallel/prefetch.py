@@ -46,6 +46,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from .. import flow
+from ..obs import timeline
 from ..utils import metrics
 
 __all__ = [
@@ -76,11 +77,26 @@ def _host_nbytes(tree) -> int:
     return total
 
 
-def account_h2d(nbytes: int, arrays: int = 1) -> None:
+def account_h2d(nbytes: int, arrays: int = 1, seconds: Optional[float] = None) -> None:
     """Fold one host→device transfer into the registry — the upload-side
-    sibling of `obs.tracing.account_readback`."""
+    sibling of `obs.tracing.account_readback`. When the caller measured
+    the staging call (`seconds`), the transfer also lands on the
+    timeline's `h2d` lane (on an async backend that duration is the
+    submit cost, not the wire time)."""
+    import time
+
     metrics.inc_counter("h2d.count", arrays)
     metrics.inc_counter("h2d.bytes", int(nbytes))
+    if timeline.enabled():
+        dur_ns = int((seconds or 0.0) * 1e9)
+        timeline.record_complete(
+            timeline.LANE_H2D,
+            "h2d",
+            time.perf_counter_ns() - dur_ns,
+            dur_ns,
+            bytes=int(nbytes),
+            arrays=arrays,
+        )
 
 
 def stage_to_device(tree, sharding=None):
@@ -88,24 +104,34 @@ def stage_to_device(tree, sharding=None):
     arrays; dtypes canonicalize exactly as `device_put` does) and count
     the host bytes moved. The one H2D funnel `models/` and `ops/` are
     allowed to call (see `scripts/check_upload_accounting.py`)."""
+    import time
+
     import jax
 
     nbytes = _host_nbytes(tree)
-    if nbytes:
-        account_h2d(nbytes)
+    t0 = time.perf_counter()
     if sharding is not None:
-        return jax.device_put(tree, sharding)
-    return jax.device_put(tree)
+        out = jax.device_put(tree, sharding)
+    else:
+        out = jax.device_put(tree)
+    if nbytes:
+        account_h2d(nbytes, seconds=time.perf_counter() - t0)
+    return out
 
 
 def stage_from_callback(shape, sharding, data_callback):
     """Accounted `jax.make_array_from_callback` (the per-shard zero-copy
     staging path of `_batchify`); bytes are counted from the staged
     array's own dtype, so callers need not precompute it."""
+    import time
+
     import jax
 
+    t0 = time.perf_counter()
     out = jax.make_array_from_callback(tuple(shape), sharding, data_callback)
-    account_h2d(int(np.prod(shape)) * out.dtype.itemsize)
+    account_h2d(
+        int(np.prod(shape)) * out.dtype.itemsize, seconds=time.perf_counter() - t0
+    )
     return out
 
 

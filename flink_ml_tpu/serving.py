@@ -69,7 +69,7 @@ import numpy as np
 
 from . import config, flow
 from .ckpt import faults
-from .obs import tracing
+from .obs import hist, timeline, tracing
 from .parallel.prefetch import next_bucket, pad_rows, slice_rows, stage_to_device
 from .pipeline import PipelineModel, _drain_guards
 from .table import SparseBatch, Table
@@ -129,6 +129,25 @@ class ServerHealth:
     bucketsSeen: int
     emaBatchMs: float  # dispatch trailing-mean latency (watchdog EMA)
     stragglers: int  # dispatches flagged beyond straggler_factor x mean
+    # per-stage latency percentiles from obs/hist.py (p50/p90/p99/p999 +
+    # count per stage: queueWait, batchForm, dispatch, readback,
+    # deadlineMargin) — the SLO surface; empty until samples exist or
+    # when histograms are disabled
+    stageLatencyMs: Dict[str, Dict[str, float]] = None
+
+    #: The serving stage-attribution histograms (obs/hist.py names, all
+    #: in milliseconds): queue-wait (submit -> dispatch start), batch
+    #: formation (pad + H2D upload), dispatch (fused-plan launch),
+    #: readback (the one blocking guard drain), and the remaining
+    #: deadline margin at delivery (clamped at 0; lateness lands in
+    #: `serving.lateByMs` and the deadlineMiss.late counter).
+    STAGES = (
+        ("queueWait", "serving.queueWaitMs"),
+        ("batchForm", "serving.batchFormMs"),
+        ("dispatch", "serving.dispatchMs"),
+        ("readback", "serving.readbackMs"),
+        ("deadlineMargin", "serving.deadlineMarginMs"),
+    )
 
 
 class MicroBatchServer:
@@ -243,8 +262,31 @@ class MicroBatchServer:
 
         def attempt():
             faults.tick("serving.batch")
+            t0 = time.perf_counter()
             staged, n = self._stage_batch(batch)
+            t1 = time.perf_counter()
             out, pending = self.model.transform_deferred(staged)
+            t2 = time.perf_counter()
+            # stage attribution (obs/hist.py): where a request's latency
+            # sits BEFORE the blocking drain — the serving mirror of the
+            # training loop's dispatch-wall split
+            hist.record("serving.batchFormMs", (t1 - t0) * 1000.0)
+            hist.record("serving.dispatchMs", (t2 - t1) * 1000.0)
+            if timeline.enabled():
+                timeline.record_complete(
+                    timeline.LANE_SERVING,
+                    "serving.batchForm",
+                    int(t0 * 1e9),
+                    int((t1 - t0) * 1e9),
+                    index=index,
+                )
+                timeline.record_complete(
+                    timeline.LANE_SERVING,
+                    "serving.dispatch",
+                    int(t1 * 1e9),
+                    int((t2 - t1) * 1e9),
+                    index=index,
+                )
             return out, pending, n
 
         with tracing.span("serving.batch", index=index, op="dispatch"):
@@ -264,12 +306,23 @@ class MicroBatchServer:
         readback (the batch's only blocking sync), then slice the padding
         off on device. The guard outcome feeds the attached lifecycle's
         health window (rollback trigger)."""
+        t0 = time.perf_counter()
         try:
             _drain_guards(pending)
         except Exception as e:
             if self.lifecycle is not None:
                 self.lifecycle.record_guard_error(e)
             raise
+        finally:
+            dt = time.perf_counter() - t0
+            hist.record("serving.readbackMs", dt * 1000.0)
+            if timeline.enabled():
+                timeline.record_complete(
+                    timeline.LANE_SERVING,
+                    "serving.readback",
+                    int(t0 * 1e9),
+                    int(dt * 1e9),
+                )
         if self.lifecycle is not None:
             self.lifecycle.record_serve_ok()
         if out.num_rows == n:
@@ -342,7 +395,7 @@ class MicroBatchServer:
         deadline = None if ms is None else time.monotonic() + ms / 1000.0
         seq = self._seq
         try:
-            self._requests.put((seq, batch, deadline))
+            self._requests.put((seq, batch, deadline, time.monotonic()))
         except flow.ChannelRejected as e:
             metrics.inc_counter("serving.rejected")
             raise ServerOverloaded(e.channel, e.depth, e.capacity) from None
@@ -366,7 +419,15 @@ class MicroBatchServer:
 
     def health(self) -> ServerHealth:
         """A `ServerHealth` snapshot of queues, overload decisions, retry
-        spend and dispatch latency."""
+        spend, dispatch latency, and the per-stage latency percentiles
+        (`stageLatencyMs`, from the obs/hist.py histograms)."""
+        stage_latency: Dict[str, Dict[str, float]] = {}
+        for label, hist_name in ServerHealth.STAGES:
+            p = hist.percentiles(hist_name)
+            if p is not None:
+                stage_latency[label] = {
+                    k: p[k] for k in ("count", "p50", "p90", "p99", "p999")
+                }
         window_depth = len(self._window) if self._window is not None else 0
         adm_depth = len(self._requests) if self._requests is not None else 0
         rejected = (
@@ -389,6 +450,7 @@ class MicroBatchServer:
             bucketsSeen=len(self._buckets_seen),
             emaBatchMs=self.watchdog.trailing_mean_s * 1000.0,
             stragglers=metrics.get_counter("flow.straggler.serving.batch", 0),
+            stageLatencyMs=stage_latency,
         )
 
     def _run(self) -> None:
@@ -398,11 +460,17 @@ class MicroBatchServer:
         window = flow.BoundedChannel(self.in_flight, policy=flow.BLOCK, name="serving.window")
         self._window = window
         try:
-            for seq, batch, deadline in self._requests:
+            for seq, batch, deadline, submitted in self._requests:
+                hist.record(
+                    "serving.queueWaitMs", (time.monotonic() - submitted) * 1000.0
+                )
                 if deadline is not None and time.monotonic() > deadline:
                     # shed BEFORE paying staging/compute: the client
-                    # already gave up on this request
+                    # already gave up on this request. Cause-attributed:
+                    # expired-IN-QUEUE (vs late-after-dispatch below) —
+                    # `serving.deadlineMiss` stays the compatibility sum
                     metrics.inc_counter("serving.deadlineMiss")
+                    metrics.inc_counter("serving.deadlineMiss.expired")
                     self._count("expired")
                     self._emit(ServeResult(seq, "expired"))
                     continue
@@ -432,10 +500,18 @@ class MicroBatchServer:
             self._emit(ServeResult(seq, "error", error=e))
             return
         status = "ok"
-        if deadline is not None and time.monotonic() > deadline:
-            metrics.inc_counter("serving.deadlineMiss")
-            self._count("late")
-            status = "late"
+        if deadline is not None:
+            margin_ms = (deadline - time.monotonic()) * 1000.0
+            if margin_ms < 0:
+                # cause-attributed miss: finished LATE after dispatch (the
+                # compute was paid — contrast deadlineMiss.expired)
+                metrics.inc_counter("serving.deadlineMiss")
+                metrics.inc_counter("serving.deadlineMiss.late")
+                hist.record("serving.lateByMs", -margin_ms)
+                self._count("late")
+                status = "late"
+            else:
+                hist.record("serving.deadlineMarginMs", margin_ms)
         self._emit(ServeResult(seq, status, table=table))
 
     def _emit(self, result: ServeResult) -> None:

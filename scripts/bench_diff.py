@@ -1,0 +1,373 @@
+#!/usr/bin/env python
+"""bench_diff — the BENCH regression gate: diff two benchmark JSON files.
+
+Usage:
+    python scripts/bench_diff.py OLD.json NEW.json [options]
+    python scripts/bench_diff.py --latest [--dir D]   (two newest BENCH_r*.json)
+
+Options:
+    --check               Explicit gate mode for CI (gating is always on;
+                          the flag documents intent in workflow files).
+    --threshold F         Default regression threshold as a fraction
+                          (default 0.15: a gated metric may move 15% the
+                          wrong way before the gate fires).
+    --rule GLOB=F         Per-metric threshold override, repeatable. GLOB
+                          matches the `entry.metric` path, e.g.
+                          --rule 'kmeans.totalTimeMs=0.30'
+                          --rule '*.hostSyncCount=0.0'
+    --gate-all            Also gate metrics that are informational by
+                          default (byte counters, depths, counts).
+    --format table|json   Output format (default table).
+    --quiet               Only print regressions (and the verdict line).
+
+Exit status: 0 = no gated metric regressed, 1 = regression(s), 2 = usage
+or unreadable input.
+
+Accepted file shapes (auto-detected):
+- the `bench.py` headline line: {"metric", "value", ..., "details": {...}}
+- the driver wrapper around it: {"n", "cmd", "rc", "tail", "parsed"} —
+  when `parsed` is null (the headline line fell off the captured tail),
+  named `"entry": {...}` fragments are RECOVERED from the raw tail text,
+  so a truncated capture still gates on the entries it retained.
+- `flink_ml_tpu.benchmark` runner --output-file: {name: {stage, results}}
+- any flat {entry: {metric: number}} dict.
+
+Gating policy: a metric is gated when its direction is known —
+lower-better (`*TimeMs`, `*Ms`, `relDiff`, `hostSyncCount`, …) or
+higher-better (`*Throughput*`, `*PerSec`, `*MFU*`, `vs_baseline`, …).
+`coldTimeMs` (compile noise) and workload-shape counters are
+informational unless --gate-all / an explicit --rule covers them.
+Regression = the metric moved MORE than the threshold in its bad
+direction; improvements and new/removed metrics never fail the gate.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import glob as globlib
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# direction + gating policy
+# ---------------------------------------------------------------------------
+
+_LOWER_BETTER = (
+    "timems",
+    "wallms",
+    "epochmsamortized",
+    "hostdispatchms",
+    "dispatchgapms",
+    "reldiff",
+    "hostsynccount",
+)
+_HIGHER_BETTER = (
+    "throughput",
+    "persec",
+    "mfu",
+    "vs_baseline",
+    "vspublishedbaseline",
+    "hbmutilization",
+    "value",
+    "parity",
+)
+#: Lower-better but too noisy to gate by default (first-run XLA compile).
+_DEFAULT_INFORMATIONAL = ("coldtimems",)
+
+#: Entries that measure the HOST (the numpy reference baseline), not this
+#: system — a slower CI machine is not a regression. Informational unless
+#: an explicit --rule covers them.
+_DEFAULT_INFO_ENTRIES = ("cpuBaseline",)
+
+
+def metric_direction(name: str) -> Optional[str]:
+    """'lower' / 'higher' / None (unknown direction = informational)."""
+    leaf = name.rsplit(".", 1)[-1].lower()
+    for pat in _HIGHER_BETTER:
+        if pat in leaf:
+            return "higher"
+    for pat in _LOWER_BETTER:
+        if leaf.endswith(pat) or leaf == pat:
+            return "lower"
+    if leaf.endswith("ms"):
+        return "lower"
+    return None
+
+
+def is_gated(path: str, gate_all: bool) -> bool:
+    leaf = path.rsplit(".", 1)[-1].lower()
+    if not gate_all and leaf in _DEFAULT_INFORMATIONAL:
+        return False
+    return metric_direction(path) is not None or gate_all
+
+
+# ---------------------------------------------------------------------------
+# loading + normalization
+# ---------------------------------------------------------------------------
+
+def _recover_fragments(text: str) -> Dict[str, Dict]:
+    """Pull named `"key": {...}` JSON fragments out of raw (possibly
+    truncated) output text, keeping only the OUTERMOST parseable ones.
+    This is the salvage path for a captured tail whose headline JSON
+    line was cut mid-stream."""
+    decoder = json.JSONDecoder()
+    found: List[Tuple[int, int, str, Dict]] = []  # (start, end, name, obj)
+    for m in re.finditer(r'"([A-Za-z_][\w.\-]*)":\s*\{', text):
+        start = m.end() - 1
+        try:
+            obj, end = decoder.raw_decode(text, start)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            found.append((start, start + (end - start), m.group(1), obj))
+    out: Dict[str, Dict] = {}
+    for start, end, name, obj in found:
+        if any(s < start and end <= e for s, e, _, _ in found):
+            continue  # nested inside a larger recovered fragment
+        if any(isinstance(v, (int, float)) and not isinstance(v, bool) for v in obj.values()):
+            out[name] = obj
+    return out
+
+
+def normalize(doc) -> Dict[str, Dict]:
+    """Any accepted file shape -> {entry: {metric: value, ...}}."""
+    if not isinstance(doc, dict):
+        raise ValueError("benchmark file is not a JSON object")
+    if "parsed" in doc and "tail" in doc:  # driver wrapper
+        if isinstance(doc.get("parsed"), dict):
+            return normalize(doc["parsed"])
+        return _recover_fragments(str(doc.get("tail") or ""))
+    if "details" in doc and isinstance(doc["details"], dict):  # headline
+        entries: Dict[str, Dict] = {}
+        headline = {
+            k: v
+            for k, v in doc.items()
+            if k in ("value", "vs_baseline")
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+        }
+        if headline:
+            entries["headline"] = headline
+        for name, entry in doc["details"].items():
+            if isinstance(entry, dict):
+                entries[name] = entry
+        return entries
+    if all(
+        isinstance(v, dict) and "results" in v and "stage" in v
+        for v in doc.values()
+        if isinstance(v, dict)
+    ) and any(isinstance(v, dict) for v in doc.values()):  # runner output
+        return {
+            name: v["results"]
+            for name, v in doc.items()
+            if isinstance(v, dict) and isinstance(v.get("results"), dict)
+        }
+    return {name: v for name, v in doc.items() if isinstance(v, dict)}
+
+
+_SKIP_SUBTREES = ("metrics", "sweep", "collectiveBreakdown", "kernels", "byCategory")
+
+
+def flatten(entry: Dict, prefix: str = "", depth: int = 2) -> Dict[str, float]:
+    """Numeric scalars of one entry as dotted paths (bounded depth;
+    embedded registry deltas and kernel tables are skipped — they have
+    their own tooling)."""
+    out: Dict[str, float] = {}
+    for key, value in entry.items():
+        if key in _SKIP_SUBTREES:
+            continue
+        path = f"{prefix}{key}"
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[path] = float(value)
+        elif isinstance(value, dict) and depth > 0:
+            out.update(flatten(value, prefix=path + ".", depth=depth - 1))
+    return out
+
+
+def load_bench(path: str) -> Dict[str, Dict[str, float]]:
+    with open(path) as f:
+        doc = json.load(f)
+    return {name: flatten(entry) for name, entry in normalize(doc).items()}
+
+
+def latest_pair(directory: str) -> Tuple[str, str]:
+    files = sorted(
+        globlib.glob(os.path.join(directory, "BENCH_*.json")),
+        key=lambda p: os.path.basename(p),
+    )
+    if len(files) < 2:
+        raise FileNotFoundError(
+            f"--latest needs two BENCH_*.json files under {directory!r}, "
+            f"found {len(files)}"
+        )
+    return files[-2], files[-1]
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+
+#: Gated time metrics below this old-value floor are jitter, not signal.
+_MIN_GATED_MS = 5.0
+
+
+def diff_entries(
+    old: Dict[str, Dict[str, float]],
+    new: Dict[str, Dict[str, float]],
+    threshold: float,
+    rules: List[Tuple[str, float]],
+    gate_all: bool = False,
+) -> List[Dict]:
+    rows: List[Dict] = []
+    for entry in sorted(set(old) & set(new)):
+        o_metrics, n_metrics = old[entry], new[entry]
+        for metric in sorted(set(o_metrics) & set(n_metrics)):
+            path = f"{entry}.{metric}"
+            o, n = o_metrics[metric], n_metrics[metric]
+            direction = metric_direction(metric)
+            thr = threshold
+            explicit = False
+            for pattern, value in rules:
+                if fnmatch.fnmatch(path, pattern):
+                    thr, explicit = value, True
+            gated = explicit or (
+                entry not in _DEFAULT_INFO_ENTRIES and is_gated(metric, gate_all)
+            )
+            delta = (n - o) / abs(o) if o else (0.0 if n == o else float("inf"))
+            verdict = "info"
+            if gated and direction is not None:
+                if o == 0 and n == 0:
+                    verdict = "ok"
+                elif direction == "lower":
+                    small = metric.lower().endswith("ms") and o < _MIN_GATED_MS and n < _MIN_GATED_MS
+                    if small and not explicit:
+                        verdict = "ok"
+                    elif o == 0:
+                        verdict = "REGRESSED" if n > 0 and thr < float("inf") else "ok"
+                    else:
+                        verdict = "REGRESSED" if delta > thr else ("improved" if delta < -thr else "ok")
+                else:  # higher-better
+                    verdict = "REGRESSED" if delta < -thr else ("improved" if delta > thr else "ok")
+            rows.append(
+                {
+                    "path": path,
+                    "old": o,
+                    "new": n,
+                    "deltaPct": delta * 100.0 if o else None,
+                    "direction": direction,
+                    "threshold": thr if gated and direction is not None else None,
+                    "verdict": verdict,
+                }
+            )
+    return rows
+
+
+def render_table(rows: List[Dict], quiet: bool = False) -> str:
+    headers = ["metric", "old", "new", "delta", "verdict"]
+    body = []
+    for r in rows:
+        if quiet and r["verdict"] != "REGRESSED":
+            continue
+        delta = f"{r['deltaPct']:+.1f}%" if r["deltaPct"] is not None else "-"
+        body.append(
+            [r["path"], f"{r['old']:.6g}", f"{r['new']:.6g}", delta, r["verdict"]]
+        )
+    if not body:
+        return "(no comparable metrics)" if not quiet else "(no regressions)"
+    widths = [max(len(h), *(len(row[i]) for row in body)) for i, h in enumerate(headers)]
+
+    def fmt(cells):
+        return "  ".join(
+            c.ljust(w) if i == 0 else c.rjust(w)
+            for i, (c, w) in enumerate(zip(cells, widths))
+        )
+
+    lines = [fmt(headers), fmt(["-" * w for w in widths])]
+    lines.extend(fmt(row) for row in body)
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    args = list(argv)
+
+    def take_opt(flag: str, default=None):
+        if flag in args:
+            i = args.index(flag)
+            value = args[i + 1]
+            del args[i : i + 2]
+            return value
+        return default
+
+    threshold = float(take_opt("--threshold", "0.15"))
+    fmt = take_opt("--format", "table")
+    directory = take_opt("--dir", ".")
+    rules: List[Tuple[str, float]] = []
+    while "--rule" in args:
+        spec = take_opt("--rule")
+        pattern, _, value = spec.partition("=")
+        if not value:
+            print(f"--rule needs GLOB=FRACTION, got {spec!r}", file=sys.stderr)
+            return 2
+        rules.append((pattern, float(value)))
+    gate_all = "--gate-all" in args
+    quiet = "--quiet" in args
+    want_latest = "--latest" in args
+    for flag in ("--check", "--gate-all", "--quiet", "--latest"):
+        if flag in args:
+            args.remove(flag)
+    paths = [a for a in args if not a.startswith("-")]
+    try:
+        if want_latest:
+            old_path, new_path = latest_pair(directory)
+        elif len(paths) == 2:
+            old_path, new_path = paths
+        else:
+            print("need OLD.json NEW.json (or --latest); see --help", file=sys.stderr)
+            return 2
+        old = load_bench(old_path)
+        new = load_bench(new_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+
+    rows = diff_entries(old, new, threshold, rules, gate_all=gate_all)
+    regressions = [r for r in rows if r["verdict"] == "REGRESSED"]
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "old": old_path,
+                    "new": new_path,
+                    "threshold": threshold,
+                    "rows": rows,
+                    "regressions": len(regressions),
+                },
+                indent=2,
+            )
+        )
+    else:
+        print(f"bench_diff: {old_path} -> {new_path} (threshold {threshold:.0%})")
+        print(render_table(rows, quiet=quiet))
+        shared = len(set(old) & set(new))
+        print(
+            f"\n{shared} shared entries, {len(rows)} compared metrics, "
+            f"{len(regressions)} regression(s)"
+        )
+        for r in regressions:
+            print(
+                f"  REGRESSED {r['path']}: {r['old']:.6g} -> {r['new']:.6g} "
+                f"({r['deltaPct']:+.1f}%, allowed ±{r['threshold']:.0%})"
+            )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -11,8 +11,14 @@ Options:
                             vs the host-side span accounting.
     --max-epochs N          Rows to print in the epoch table (default 20;
                             the TOTAL row always aggregates all epochs).
-    --json                  Emit the raw breakdown tables as JSON instead
-                            of text (for dashboards / CI assertions).
+    --format text|json      Output format (default text). JSON emits the
+                            raw breakdown tables (for dashboards / CI
+                            assertions). `--json` is the legacy alias.
+
+Robustness: ring-truncated and mid-span-truncated trace files are
+expected inputs — unparseable lines, unmatched begin/end pairs (timeline
+dumps) and malformed records are dropped with a warning on stderr, never
+a crash.
 
 Capture a trace by running any workload with
 `FLINK_ML_TPU_TRACE_FILE=/tmp/trace.jsonl` set, e.g.:
@@ -43,12 +49,26 @@ def main(argv):
     max_epochs = 20
     if "--max-epochs" in argv:
         max_epochs = int(argv[argv.index("--max-epochs") + 1])
-    records = report.load_trace(trace_path)
+    fmt = "text"
+    if "--format" in argv:
+        fmt = argv[argv.index("--format") + 1]
+        if fmt not in ("text", "json"):
+            print(f"unknown --format {fmt!r} (text|json)", file=sys.stderr)
+            return 2
+    if "--json" in argv:  # legacy alias
+        fmt = "json"
+    records, dropped = report.sanitize_records(report.load_trace(trace_path))
+    if dropped:
+        print(
+            f"warning: dropped {dropped} unmatched/malformed record(s) "
+            "(ring- or mid-span-truncated trace)",
+            file=sys.stderr,
+        )
     if not records:
         print(f"No span records in {trace_path}.", file=sys.stderr)
         return 1
 
-    if "--json" in argv:
+    if fmt == "json":
         trace = report.Trace(records)
         payload = {
             "stages": [

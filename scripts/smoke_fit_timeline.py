@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""smoke_fit_timeline — one chunked traced LR fit for the CI flight
+recorder artifact.
+
+Runs a small chunked (checkpointed) SGD fit with the timeline ring
+enabled, dumps the event JSONL (FLINK_ML_TPU_TIMELINE_FILE wins if set),
+and prints the dispatch-wall attribution. CI renders the dump with
+scripts/obs_timeline.py and uploads both as the per-run Perfetto
+artifact (docs/observability.md).
+
+Usage: python scripts/smoke_fit_timeline.py [EVENTS_OUT.jsonl]
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv):
+    out_path = argv[0] if argv else os.environ.get(
+        "FLINK_ML_TPU_TIMELINE_FILE", "timeline-events.jsonl"
+    )
+    import numpy as np
+
+    from flink_ml_tpu import config
+    from flink_ml_tpu.obs import timeline
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+
+    timeline.configure(ring_size=65536)
+    config.iteration_chunk_size = 8
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 8).astype(np.float32)
+    y = (X @ np.linspace(1, -1, 8) > 0).astype(np.float32)
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        sgd = SGD(
+            max_iter=56,
+            global_batch_size=100,
+            tol=0.0,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_interval=8,
+        )
+        _, _, epochs = sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+    n = timeline.dump_jsonl(out_path)
+    attr = timeline.dispatch_attribution()
+    print(f"smoke fit: {epochs} epochs, {n} timeline events -> {out_path}")
+    if attr:
+        print(
+            "attribution: "
+            + ", ".join(
+                f"{k} {attr[k]:.1f}ms"
+                for k in ("windowMs", "dispatchMs", "deviceMs", "readbackMs", "idleGapMs")
+            )
+            + f" over {attr['gapCount']} chunks"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,201 @@
+"""bench_diff regression gate (scripts/bench_diff.py) — file-shape
+normalization (headline / driver wrapper / truncated-tail recovery),
+direction + threshold policy, and the two acceptance cases: the
+synthetic 20% wallMs regression exits nonzero, the real checked-in
+BENCH_r04 -> BENCH_r05 pair exits zero."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXDIR = os.path.join(_ROOT, "tests", "fixtures", "bench_diff")
+
+
+def _load_module():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(_ROOT, "scripts", "bench_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_diff = _load_module()
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "bench_diff.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=_ROOT,
+    )
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+
+def test_metric_directions():
+    assert bench_diff.metric_direction("totalTimeMs") == "lower"
+    assert bench_diff.metric_direction("wallMs") == "lower"
+    assert bench_diff.metric_direction("epochMsAmortized") == "lower"
+    assert bench_diff.metric_direction("hostSyncCount") == "lower"
+    assert bench_diff.metric_direction("relDiff") == "lower"
+    assert bench_diff.metric_direction("inputThroughput") == "higher"
+    assert bench_diff.metric_direction("trainedExamplesPerSec") == "higher"
+    assert bench_diff.metric_direction("trainLoopMFU_trace") == "higher"
+    assert bench_diff.metric_direction("vsPublishedBaseline") == "higher"
+    assert bench_diff.metric_direction("numChips") is None
+    assert bench_diff.metric_direction("h2dBytes") is None  # info by default
+
+
+def test_cold_time_informational_by_default():
+    rows = bench_diff.diff_entries(
+        {"e": {"coldTimeMs": 100.0}}, {"e": {"coldTimeMs": 200.0}}, 0.15, []
+    )
+    assert rows[0]["verdict"] == "info"
+    # ...unless an explicit rule gates it
+    rows = bench_diff.diff_entries(
+        {"e": {"coldTimeMs": 100.0}},
+        {"e": {"coldTimeMs": 200.0}},
+        0.15,
+        [("e.coldTimeMs", 0.5)],
+    )
+    assert rows[0]["verdict"] == "REGRESSED"
+
+
+def test_threshold_and_direction_semantics():
+    old = {"e": {"totalTimeMs": 100.0, "inputThroughput": 1000.0}}
+    ok = {"e": {"totalTimeMs": 110.0, "inputThroughput": 900.0}}
+    bad = {"e": {"totalTimeMs": 130.0, "inputThroughput": 700.0}}
+    rows = {r["path"]: r for r in bench_diff.diff_entries(old, ok, 0.15, [])}
+    assert rows["e.totalTimeMs"]["verdict"] == "ok"
+    assert rows["e.inputThroughput"]["verdict"] == "ok"
+    rows = {r["path"]: r for r in bench_diff.diff_entries(old, bad, 0.15, [])}
+    assert rows["e.totalTimeMs"]["verdict"] == "REGRESSED"
+    assert rows["e.inputThroughput"]["verdict"] == "REGRESSED"
+    # improvements never fail
+    better = {"e": {"totalTimeMs": 50.0, "inputThroughput": 2000.0}}
+    rows = bench_diff.diff_entries(old, better, 0.15, [])
+    assert all(r["verdict"] == "improved" for r in rows)
+
+
+def test_small_time_jitter_not_gated():
+    rows = bench_diff.diff_entries(
+        {"e": {"fitTimeMs": 1.0}}, {"e": {"fitTimeMs": 3.0}}, 0.15, []
+    )
+    assert rows[0]["verdict"] == "ok"  # below the 5ms jitter floor
+
+
+def test_cpu_baseline_entry_informational():
+    rows = bench_diff.diff_entries(
+        {"cpuBaseline": {"totalTimeMs": 20000.0}},
+        {"cpuBaseline": {"totalTimeMs": 90000.0}},
+        0.15,
+        [],
+    )
+    assert rows[0]["verdict"] == "info"  # host speed is not our regression
+
+
+# ---------------------------------------------------------------------------
+# normalization + recovery
+# ---------------------------------------------------------------------------
+
+def test_normalize_headline_and_wrapper():
+    headline = {"value": 1.0, "vs_baseline": 2.0, "details": {"kmeans": {"totalTimeMs": 5.0}}}
+    entries = bench_diff.normalize(headline)
+    assert entries["headline"] == {"value": 1.0, "vs_baseline": 2.0}
+    assert entries["kmeans"]["totalTimeMs"] == 5.0
+    wrapper = {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": headline}
+    assert bench_diff.normalize(wrapper) == entries
+
+
+def test_tail_recovery_outermost_fragments():
+    """A truncated driver tail (headline JSON cut mid-line) still yields
+    the complete per-entry fragments — outermost only, so a nested dict
+    inside a recovered entry is not double-reported."""
+    tail = (
+        '4810.43, "unit": "records/s/chip", "det'  # cut headline
+        '"kmeans": {"coldTimeMs": 800.0, "totalTimeMs": 200.0, '
+        '"inner": {"x": 1.0}}, '
+        '"sweep": {"file": "benchmarks/SWEEP.json"}'
+    )
+    wrapper = {"n": 5, "cmd": "x", "rc": 0, "tail": tail, "parsed": None}
+    entries = bench_diff.normalize(wrapper)
+    assert "kmeans" in entries
+    assert entries["kmeans"]["totalTimeMs"] == 200.0
+    assert "inner" not in entries  # nested fragment folded into kmeans
+    assert "sweep" not in entries  # no numeric leaves -> not an entry
+
+
+def test_real_r05_tail_recovers_entries():
+    with open(os.path.join(_ROOT, "BENCH_r05.json")) as f:
+        entries = bench_diff.normalize(json.load(f))
+    assert "sparseWideLR" in entries and "kmeans" in entries
+    assert entries["kmeans"]["totalTimeMs"] > 0
+
+
+def test_flatten_skips_registry_and_bounds_depth():
+    entry = {
+        "totalTimeMs": 5.0,
+        "ok": True,
+        "metrics": {"counters": {"x": 1}},
+        "dispatchAttribution": {"windowMs": 4.0, "perEpoch": {"wallMs": 1.0}},
+    }
+    flat = bench_diff.flatten(entry)
+    assert flat["totalTimeMs"] == 5.0
+    assert "ok" not in flat  # bools are not metrics
+    assert not any(k.startswith("metrics") for k in flat)
+    assert flat["dispatchAttribution.windowMs"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: CLI exit codes
+# ---------------------------------------------------------------------------
+
+def test_cli_synthetic_20pct_wallms_regression_exits_nonzero():
+    out = _run_cli(
+        os.path.join(_FIXDIR, "BENCH_base.json"),
+        os.path.join(_FIXDIR, "BENCH_regressed.json"),
+        "--check",
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSED" in out.stdout
+    assert "wallMs" in out.stdout
+
+
+def test_cli_real_r04_r05_pair_exits_zero():
+    out = _run_cli("BENCH_r04.json", "BENCH_r05.json", "--check")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 regression(s)" in out.stdout
+
+
+def test_cli_json_format_and_rules():
+    out = _run_cli(
+        os.path.join(_FIXDIR, "BENCH_base.json"),
+        os.path.join(_FIXDIR, "BENCH_regressed.json"),
+        "--format", "json",
+        "--rule", "logisticregressionTrace.*=0.5",
+    )
+    assert out.returncode == 0, out.stdout + out.stderr  # 20% < 50% override
+    doc = json.loads(out.stdout)
+    assert doc["regressions"] == 0
+    assert any(r["path"] == "logisticregressionTrace.wallMs" for r in doc["rows"])
+
+
+def test_cli_latest_pair_and_usage_errors(tmp_path):
+    for name, wall in (("BENCH_r01.json", 100.0), ("BENCH_r02.json", 101.0)):
+        with open(tmp_path / name, "w") as f:
+            json.dump({"e": {"totalTimeMs": wall}}, f)
+    out = _run_cli("--latest", "--dir", str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "BENCH_r01.json" in out.stdout and "BENCH_r02.json" in out.stdout
+    assert _run_cli().returncode == 0  # no args -> usage text, rc 0
+    assert _run_cli("only_one.json").returncode == 2
+    assert _run_cli("missing_a.json", "missing_b.json").returncode == 2

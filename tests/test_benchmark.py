@@ -89,12 +89,22 @@ class TestRunner:
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
+            "hostDispatchMs", "dispatchGapMs", "gapCount", "dispatchAttribution",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
             "retryCount", "shedCount", "rejectCount", "peakQueueDepth",
             "swapCount", "rollbackCount", "promoteRejected",
         }
         assert result["hostSyncCount"] >= 1  # the packed fit readback
+        # dispatch-wall attribution fields: the Lloyd program launch rides
+        # the timed_dispatch funnel, and the gap is bounded by the work wall
+        assert result["gapCount"] >= 1
+        assert result["hostDispatchMs"] > 0
+        work_ms = (
+            result["phaseTimesMs"]["fit"] + result["phaseTimesMs"]["transform"]
+        )
+        assert 0.0 <= result["dispatchGapMs"] <= work_ms + 1e-6
+        assert result["dispatchAttribution"] is None  # timeline off here
         # flow-control fields: a clean run pays no retries/sheds/rejects
         assert result["retryCount"] == 0
         assert result["shedCount"] == 0

@@ -392,3 +392,169 @@ def test_benchmark_runner_embeds_metrics(mesh8):
     assert "benchmark.KMeans-obs.fit" in embedded["timers"]
     # the BENCH payload stays json-serializable
     json.dumps(result)
+
+
+# ---------------------------------------------------------------------------
+# exporter gaps closed (ISSUE 12): histograms, collision check, BENCH fields
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _clean_hist():
+    from flink_ml_tpu.obs import hist
+
+    hist.reset()
+    hist.configure(True)
+    yield hist
+    hist.reset()
+    hist.configure(True)
+
+
+def test_prometheus_exports_flow_and_lifecycle_counters(_clean_hist):
+    """The PR 8/10 counters stop being runner-JSON-only: once incremented
+    they appear in the Prometheus exposition."""
+    metrics.inc_counter("flow.retry", 3)
+    metrics.inc_counter("flow.shed", 2)
+    metrics.inc_counter("flow.reject", 1)
+    metrics.inc_counter("lifecycle.swap", 4)
+    metrics.inc_counter("lifecycle.rollback", 1)
+    metrics.inc_counter("serving.deadlineMiss", 2)
+    metrics.inc_counter("serving.deadlineMiss.expired", 1)
+    metrics.inc_counter("serving.deadlineMiss.late", 1)
+    metrics.set_gauge("flow.lag.online.ingest", 3)
+    text = exporters.snapshot_prometheus()
+    for line in (
+        "flink_ml_tpu_flow_retry_total 3",
+        "flink_ml_tpu_flow_shed_total 2",
+        "flink_ml_tpu_flow_reject_total 1",
+        "flink_ml_tpu_lifecycle_swap_total 4",
+        "flink_ml_tpu_lifecycle_rollback_total 1",
+        "flink_ml_tpu_serving_deadlineMiss_total 2",
+        "flink_ml_tpu_serving_deadlineMiss_expired_total 1",
+        "flink_ml_tpu_serving_deadlineMiss_late_total 1",
+        "flink_ml_tpu_flow_lag_online_ingest 3",
+    ):
+        assert line in text, line
+
+
+def test_prometheus_histogram_exposition(_clean_hist):
+    from flink_ml_tpu.obs import hist
+
+    for v in (1.0, 1.5, 3.0, 100.0):
+        hist.record("serving.dispatchMs", v)
+    text = exporters.snapshot_prometheus()
+    assert "# TYPE flink_ml_tpu_serving_dispatchMs histogram" in text
+    assert 'flink_ml_tpu_serving_dispatchMs_bucket{le="+Inf"} 4' in text
+    assert "flink_ml_tpu_serving_dispatchMs_sum 105.5" in text
+    assert "flink_ml_tpu_serving_dispatchMs_count 4" in text
+    # buckets are cumulative and end at the total count
+    import re as _re
+
+    counts = [
+        int(m.group(1))
+        for m in _re.finditer(
+            r'flink_ml_tpu_serving_dispatchMs_bucket\{le="[^+]+"\} (\d+)', text
+        )
+    ]
+    assert counts == sorted(counts) and counts[-1] <= 4
+
+
+def test_prometheus_name_collision_check(_clean_hist):
+    from flink_ml_tpu.obs import hist
+
+    metrics.inc_counter("a.b")
+    metrics.inc_counter("a_b")  # sanitizes to the same series
+    collisions = exporters.check_name_collisions()
+    assert any("a_b_total" in c for c in collisions)
+    with pytest.raises(ValueError, match="collision"):
+        exporters.snapshot_prometheus()
+    metrics.reset()
+    # a timer and a histogram of the same name share a `_count` series
+    metrics.record_time("dup.ms", 0.1)
+    hist.record("dup.ms", 0.1)
+    assert any("dup_ms_count" in c for c in exporters.check_name_collisions())
+    # a clean registry passes
+    metrics.reset()
+    hist.reset()
+    metrics.inc_counter("readback.bytes", 1)
+    assert exporters.check_name_collisions() == []
+
+
+def test_bench_entry_prometheus_first_class_fields():
+    entry = {
+        "name": "kmeans",
+        "totalTimeMs": 12.5,
+        "hostSyncCount": 1,
+        "retryCount": 2,
+        "shedCount": 0,
+        "rejectCount": 5,
+        "swapCount": 3,
+        "rollbackCount": 1,
+        "dispatchGapMs": 90.0,
+        "gapCount": 7,
+        "retriesBitIdentical": True,  # bools are not metrics
+        "metrics": {"counters": {}},
+    }
+    text = exporters.bench_entry_prometheus(entry)
+    assert 'flink_ml_tpu_bench_totalTimeMs{benchmark="kmeans"} 12.5' in text
+    assert 'flink_ml_tpu_bench_retryCount{benchmark="kmeans"} 2' in text
+    assert 'flink_ml_tpu_bench_rejectCount{benchmark="kmeans"} 5' in text
+    assert 'flink_ml_tpu_bench_swapCount{benchmark="kmeans"} 3' in text
+    assert 'flink_ml_tpu_bench_rollbackCount{benchmark="kmeans"} 1' in text
+    assert 'flink_ml_tpu_bench_dispatchGapMs{benchmark="kmeans"} 90.0' in text
+    assert "retriesBitIdentical" not in text
+
+
+# ---------------------------------------------------------------------------
+# obs_report robustness (ISSUE 12): truncated traces, --format json
+# ---------------------------------------------------------------------------
+
+def test_sanitize_records_drops_unmatched_with_count():
+    records = [
+        {"name": "ok", "spanId": 1, "parentId": 0, "startUs": 0.0, "durUs": 5.0,
+         "attrs": {}},
+        {"ph": "B", "lane": "host:t", "name": "pair", "tsUs": 10.0, "ref": 2},
+        {"ph": "E", "lane": "host:t", "name": "pair", "tsUs": 30.0, "ref": 2,
+         "args": {"k": 1}},
+        {"ph": "E", "lane": "host:t", "name": "lost", "tsUs": 40.0, "ref": 3},
+        {"ph": "B", "lane": "host:t", "name": "open", "tsUs": 50.0, "ref": 4},
+        {"name": "no_span_id", "startUs": 1.0},
+        "not even a dict",
+    ]
+    clean, dropped = report.sanitize_records(records)
+    assert dropped == 4  # lost-E, open-B, schema-less record, non-dict
+    by_name = {r["name"]: r for r in clean}
+    assert set(by_name) == {"ok", "pair"}
+    assert by_name["pair"]["durUs"] == 20.0
+    assert by_name["pair"]["attrs"] == {"k": 1}
+    report.render_report(clean)  # renders without error
+
+
+def test_obs_report_cli_truncated_fixture():
+    """Regression (ISSUE 12): a ring-/mid-span-truncated trace file must
+    report with a warning, in both text and --format json."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixture = os.path.join(root, "tests", "fixtures", "traces", "truncated.jsonl")
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "obs_report.py"), fixture],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "dropped" in out.stderr and "truncated" in out.stderr
+    assert "KMeans.fit" in out.stdout
+    out_json = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "obs_report.py"), fixture,
+         "--format", "json"],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert out_json.returncode == 0, out_json.stderr
+    doc = json.loads(out_json.stdout)
+    assert doc["stages"] and doc["stages"][0]["label"] == "KMeans.fit"
+    bad = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "obs_report.py"), fixture,
+         "--format", "xml"],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert bad.returncode == 2

@@ -352,3 +352,106 @@ def test_health_snapshot_shape():
     assert h.inFlight == 2 and h.windowDepth == 0
     assert h.bucketsSeen == 1
     assert h.emaBatchMs >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO surface: per-stage latency histograms (obs/hist.py) — ISSUE 12
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _clean_hist():
+    from flink_ml_tpu.obs import hist
+
+    hist.reset()
+    hist.configure(True)
+    yield hist
+    hist.reset()
+    hist.configure(True)
+
+
+def test_server_health_stage_latency_percentiles(_clean_hist):
+    """ISSUE 12 acceptance: ServerHealth reports p50/p99/p999 per-stage
+    latency (queue-wait, batch-form, dispatch, readback) from the
+    obs/hist.py histograms."""
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=2, admission=16)
+    for _ in range(8):
+        server.submit(Table({"features": RNG.randn(8, 4).astype(np.float32)}))
+    server.close()
+    results = list(server.results())
+    assert all(r.status == "ok" for r in results)
+    h = server.health()
+    for stage in ("queueWait", "batchForm", "dispatch", "readback"):
+        p = h.stageLatencyMs[stage]
+        assert p["count"] >= 8, stage
+        assert 0.0 <= p["p50"] <= p["p99"] <= p["p999"], stage
+    # no deadline was set, so no margin histogram
+    assert "deadlineMargin" not in h.stageLatencyMs
+    # with a generous deadline the margin distribution appears too
+    server2 = MicroBatchServer(pm, in_flight=2, admission=16)
+    server2.submit(
+        Table({"features": RNG.randn(8, 4).astype(np.float32)}), deadline_ms=60_000.0
+    )
+    server2.close()
+    assert [r.status for r in server2.results()] == ["ok"]
+    assert server2.health().stageLatencyMs["deadlineMargin"]["count"] >= 1
+
+
+def test_serving_bit_identical_with_histograms_on_vs_off(_clean_hist):
+    """ISSUE 12 acceptance: bit-for-bit identical serving results with
+    histograms on vs off (the SLO surface never touches the data path)."""
+    from flink_ml_tpu.obs import hist
+
+    pm = _scaler_pipeline()
+    batches = _batches([5, 13, 9])
+    on = serve_stream(pm, StreamTable.from_batches(batches))
+    assert hist.percentiles("serving.dispatchMs")["count"] >= 3
+    hist.reset()
+    hist.configure(False)
+    off = serve_stream(pm, StreamTable.from_batches(batches))
+    assert hist.snapshot() == {}  # recording really was off
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(
+            np.asarray(a.column("norm")), np.asarray(b.column("norm"))
+        )
+
+
+def test_deadline_miss_cause_attribution(_clean_hist):
+    """`serving.deadlineMiss` splits into expired-in-queue vs
+    late-after-dispatch; the old name stays as their sum."""
+    import time as _time
+
+    from flink_ml_tpu import flow
+    from flink_ml_tpu.obs import hist
+
+    base_sum = metrics.get_counter("serving.deadlineMiss", 0)
+    base_expired = metrics.get_counter("serving.deadlineMiss.expired", 0)
+    base_late = metrics.get_counter("serving.deadlineMiss.late", 0)
+
+    # expired IN QUEUE: 0ms deadline passes before dispatch
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(pm, in_flight=2, admission=8)
+    server.submit(
+        Table({"features": RNG.randn(8, 4).astype(np.float32)}), deadline_ms=0.0
+    )
+    server.close()
+    (r,) = list(server.results())
+    assert r.status == "expired"
+    assert metrics.get_counter("serving.deadlineMiss.expired", 0) == base_expired + 1
+
+    # late AFTER dispatch: retire a really-transformed batch whose
+    # deadline already passed (white-box: deterministic, no sleep races)
+    late_server = MicroBatchServer(pm, in_flight=2)
+    late_server._out = flow.BoundedChannel(4, name="test.results")
+    staged, n = late_server._stage_batch(
+        Table({"features": RNG.randn(8, 4).astype(np.float32)})
+    )
+    out, pending = pm.transform_deferred(staged)
+    late_server._retire((0, _time.monotonic() - 1.0, out, pending, n))
+    result = late_server._out.get()
+    assert result.status == "late"
+    assert metrics.get_counter("serving.deadlineMiss.late", 0) == base_late + 1
+    assert hist.percentiles("serving.lateByMs")["count"] >= 1
+
+    # compatibility: the old counter is exactly the sum of the causes
+    assert metrics.get_counter("serving.deadlineMiss", 0) == base_sum + 2
