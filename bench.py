@@ -581,7 +581,10 @@ def bench_input_pipeline(num_batches=8, batch_rows=20_000, d=64, epochs=6):
         )
 
     def run(budget):
-        with config.device_cache_budget(budget):
+        # whole_fit off: this entry measures the per-epoch replay pipeline
+        # (cache vs eager re-upload); the resident path bypasses it and
+        # has its own wholeFitDispatch entry
+        with config.whole_fit_mode("off"), config.device_cache_budget(budget):
             sgd = SGD(max_iter=max_iter, global_batch_size=batch_rows, tol=0.0)
             before = metrics.snapshot()
             t0 = time.perf_counter()
@@ -622,7 +625,7 @@ def bench_input_pipeline(num_batches=8, batch_rows=20_000, d=64, epochs=6):
         kfit = lambda b: KMeans().set_k(4).set_seed(3).set_max_iter(2).fit(  # noqa: E731
             StreamTable.from_batches(b)
         )
-        with config.input_bucketing_mode(bucketing):
+        with config.whole_fit_mode("off"), config.input_bucketing_mode(bucketing):
             kfit(uniform)  # warm every kernel at the uniform batch shape
             before = metrics.get_counter("jit.compiles")
             kfit(ragged)
@@ -658,6 +661,119 @@ def bench_input_pipeline(num_batches=8, batch_rows=20_000, d=64, epochs=6):
         f"H2D/epoch cached {later_epochs_bytes / 1e6:.2f}MB vs eager "
         f"{epoch0_bytes / 1e6:.2f}MB; ragged-stream compiles bucketed "
         f"{compiles_bucketed} vs unbucketed {compiles_unbucketed}"
+    )
+    return result
+
+
+def bench_whole_fit_dispatch(n=400_000, d=32, max_iter=200, batch_rows=4096):
+    """The whole-fit resident-program workload (ISSUE 13 / ROADMAP item
+    2a): the SAME maxIter=200 out-of-core LR fit on the per-epoch dispatch
+    pipeline (`config.whole_fit` off — one dispatch + one drained readback
+    PER EPOCH) vs the resident program (one dispatch + one packed readback
+    PER FIT). Reports the dispatch count (`iteration.dispatch` launches),
+    `hostSyncCount`, host-dispatch wall and the flight-recorder
+    attribution for both sides, asserts bit-identical coefficients
+    in-process, and derives the trace-MFU proxy delta: with fixed device
+    work per fit, MFU scales as 1/wall, so the wall ratio IS the MFU lift
+    on this workload."""
+    from flink_ml_tpu import config
+    from flink_ml_tpu.obs import timeline
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(23)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.float32)
+
+    def chunks():
+        return iter(
+            [
+                (X[i : i + batch_rows], y[i : i + batch_rows], None)
+                for i in range(0, n, batch_rows)
+            ]
+        )
+
+    def run(mode):
+        with config.whole_fit_mode(mode):
+            sgd = SGD(max_iter=max_iter, global_batch_size=batch_rows, tol=0.0)
+            sgd.optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS)  # warm
+            timeline.configure(ring_size=65536)
+            mark_us = timeline.now_us()
+            before = metrics.snapshot()
+            t0 = time.perf_counter()
+            coeff, _, epochs, _ = sgd.optimize_stream(
+                None, chunks(), BINARY_LOGISTIC_LOSS
+            )
+            wall = time.perf_counter() - t0
+            delta = metrics.snapshot_delta(before, metrics.snapshot())
+            events, _ = timeline.snapshot_events()
+            attr = timeline.dispatch_attribution(
+                [e for e in events if e["tsUs"] >= mark_us]
+            )
+            timeline.configure()
+            if attr:
+                attr.pop("chunks", None)
+        return {
+            "coeff": coeff,
+            "epochs": epochs,
+            "wallMs": wall * 1000.0,
+            "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
+            "dispatchCount": int(
+                delta["timers"].get("iteration.dispatch", {}).get("count", 0)
+            ),
+            "hostDispatchMs": float(
+                delta["timers"].get("iteration.dispatch", {}).get("totalMs", 0.0)
+            ),
+            "wholeFitCount": int(delta["counters"].get("dispatch.whole_fit", 0)),
+            "wholeFitFallbacks": int(
+                delta["counters"].get("dispatch.whole_fit_fallback", 0)
+            ),
+            "attribution": attr,
+        }
+
+    chunked = run("off")
+    whole = run("auto")
+    assert np.array_equal(chunked["coeff"], whole["coeff"]), (
+        "whole-fit diverged from the chunked reference"
+    )
+    assert whole["hostSyncCount"] == 1, (
+        f"whole-fit paid {whole['hostSyncCount']} host syncs, expected 1"
+    )
+    examples = min(batch_rows, n) * max_iter
+    result = {
+        "maxIter": max_iter,
+        "inputRecordNum": n,
+        "dim": d,
+        # gated side: the resident program (lower-better leaves)
+        "wallMs": whole["wallMs"],
+        "hostSyncCount": whole["hostSyncCount"],
+        "dispatchCount": whole["dispatchCount"],
+        "hostDispatchMs": whole["hostDispatchMs"],
+        "trainedExamplesPerSec": examples / (whole["wallMs"] / 1000.0),
+        "wholeFitFallbacks": whole["wholeFitFallbacks"],
+        "dispatchAttribution": whole["attribution"],
+        # reference side (informational leaves: *Chunked has no direction)
+        "wallMsChunked": chunked["wallMs"],
+        "hostSyncCountChunked": chunked["hostSyncCount"],
+        "dispatchCountChunked": chunked["dispatchCount"],
+        "hostDispatchMsChunked": chunked["hostDispatchMs"],
+        "dispatchAttributionChunked": chunked["attribution"],
+        # fixed device work per fit => MFU ~ 1/wall: the wall ratio is
+        # the trace-MFU lift of going resident on this workload
+        "mfuProxyLift": chunked["wallMs"] / whole["wallMs"],
+        "dispatchReduction": (
+            chunked["dispatchCount"] / max(1, whole["dispatchCount"])
+        ),
+        "bitIdenticalToChunked": True,  # asserted above
+    }
+    log(
+        f"wholeFitDispatch: {chunked['dispatchCount']} dispatches/"
+        f"{chunked['hostSyncCount']} syncs -> {whole['dispatchCount']}/"
+        f"{whole['hostSyncCount']} at maxIter={max_iter}; wall "
+        f"{chunked['wallMs']:.0f}ms -> {whole['wallMs']:.0f}ms "
+        f"({result['mfuProxyLift']:.2f}x MFU proxy), hostDispatch "
+        f"{whole['hostDispatchMs']:.1f}ms of {whole['wallMs']:.0f}ms wall"
     )
     return result
 
@@ -1157,6 +1273,7 @@ def main(argv):
         "kmeans": None,
         "pipelineServing": None,
         "inputPipeline": None,
+        "wholeFitDispatch": None,
         "checkpointResume": None,
         "overloadSoak": None,
         "hotSwapSoak": None,
@@ -1241,6 +1358,12 @@ def main(argv):
                 details["inputPipeline"] = bench_input_pipeline()
             except Exception as e:
                 log(f"inputPipeline stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["wholeFitDispatch"] = bench_whole_fit_dispatch()
+            except Exception as e:
+                log(f"wholeFitDispatch stage failed: {e!r}")
 
         if in_budget():
             try:

@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     lockorder,
     meshaxis,
     precision,
+    residentprogram,
     retrace,
     shardingtags,
     specconsistency,

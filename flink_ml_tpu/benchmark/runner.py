@@ -164,6 +164,15 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         # between BENCH files is a dispatch regression
         "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
         "dispatchDepth": int(delta["gauges"].get("iteration.dispatch_depth", 0)),
+        # whole-fit resident-program evidence (parallel/dispatch.py): fits
+        # that ran as ONE dispatch + ONE packed readback, and fits that
+        # asked to but fell back to the chunked path (per-reason counters
+        # inside metrics) — a fallback jump between BENCH files means a
+        # config change quietly knocked fits off the resident path
+        "wholeFitCount": int(delta["counters"].get("dispatch.whole_fit", 0)),
+        "wholeFitFallbacks": int(
+            delta["counters"].get("dispatch.whole_fit_fallback", 0)
+        ),
         "hostDispatchMs": host_dispatch_ms,
         "dispatchGapMs": (
             max(0.0, work_ms - host_dispatch_ms) if gap_count else 0.0

@@ -39,6 +39,67 @@ def iteration_chunk_for(max_iter: int, chunk_size: Optional[int] = None) -> int:
     return max(1, min(int(k), max(1, int(max_iter))))
 
 
+# --- whole-fit resident programs (parallel/dispatch.py) -----------------------
+# "auto": eligible fits compile the ENTIRE epoch loop — per-epoch tol
+# check, final model update, and the packed result — into ONE resident
+# device program per (shape-bucket x packed-hyperparam layout), so a
+# maxIter=200 fit is exactly one dispatch and one packed readback
+# (host_sync_count == 1) regardless of the chunk knobs above. Ineligible
+# fits (a checkpoint boundary lands mid-fit, the stream data source
+# exceeds the device-cache budget, ragged stream batch shapes, a
+# per-epoch listener) fall back to the chunked DrainQueue path, counted
+# per reason under `dispatch.whole_fit_fallback` (docs/performance.md).
+# "off": always the chunked/per-epoch reference path — whole-fit results
+# are bit-identical to it by construction, pinned by
+# tests/test_dispatch_pipeline.py.
+whole_fit: str = "auto"
+
+
+@contextmanager
+def whole_fit_mode(mode: str):
+    """Scoped override of `whole_fit` ("auto" | "off")."""
+    global whole_fit
+    if mode not in ("auto", "off"):
+        raise ValueError(f"Unknown whole_fit mode {mode!r}")
+    prev = whole_fit
+    whole_fit = mode
+    try:
+        yield
+    finally:
+        whole_fit = prev
+
+
+if os.environ.get("FLINK_ML_TPU_WHOLE_FIT") in ("auto", "off"):
+    whole_fit = os.environ["FLINK_ML_TPU_WHOLE_FIT"]
+
+
+# --- Pallas sparse kernels (ops/sparsekernels.py) -----------------------------
+# Route the sparse padded-CSR gradient path (masked gather row-dots + the
+# segment-sum scatter XLA lowers poorly) through hand-written Pallas
+# kernels instead of the lax gather/scatter ops. The kernels run with
+# interpret=True on the CPU backend so tier-1 exercises them; results are
+# bit-identical to the lax path (same masking convention, same row-major
+# accumulation order — tests/test_dispatch_pipeline.py pins it). Opt-in:
+# the lax path remains the reference.
+use_pallas_sparse: bool = False
+
+
+@contextmanager
+def pallas_sparse_mode(enabled: bool = True):
+    """Scoped override of `use_pallas_sparse`."""
+    global use_pallas_sparse
+    prev = use_pallas_sparse
+    use_pallas_sparse = bool(enabled)
+    try:
+        yield
+    finally:
+        use_pallas_sparse = prev
+
+
+if os.environ.get("FLINK_ML_TPU_USE_PALLAS_SPARSE") in ("1", "true", "on"):
+    use_pallas_sparse = True
+
+
 # --- collectives: chunking, sparse reduction, comm/compute overlap ------------
 # (parallel/collectives.py + parallel/overlap.py)
 # Bucket size for all_reduce_sum_chunked: a large gradient pytree is

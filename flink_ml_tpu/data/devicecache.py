@@ -50,9 +50,22 @@ from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, Optional
 from .. import flow
 from ..utils import metrics
 
-__all__ = ["DeviceEpochCache", "CachedEpochLoader"]
+__all__ = ["DeviceEpochCache", "CachedEpochLoader", "within_device_budget"]
 
 _UNSET = object()
+
+
+def within_device_budget(nbytes: int) -> bool:
+    """Does a `nbytes` device-resident allocation fit the configured HBM
+    cache budget (`config.device_cache_bytes`)? The whole-fit eligibility
+    check (parallel/dispatch.whole_fit_plan): a resident program's stacked
+    epoch data source must fit where the per-batch cache would have lived.
+    None = unbounded budget (fits), 0 = cache disabled (nothing fits)."""
+    from .. import config
+
+    if config.device_cache_bytes is None:
+        return True
+    return int(nbytes) <= int(config.device_cache_bytes)
 
 
 def _tree_nbytes(tree) -> int:

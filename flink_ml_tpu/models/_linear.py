@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.losses import SPARSE_VARIANTS, LossFunc
+from ..ops.losses import LossFunc, sparse_variant
 from ..utils.lazyjit import lazy_jit
 from ..ops.optimizer import SGD, read_train_result
 from ..table import SparseBatch, Table, as_dense_matrix
@@ -126,7 +126,8 @@ def run_sgd(
     if isinstance(X, tuple):  # sparse: train on padded CSR, no densify
         indices, values, dim = X
         X = (indices, values)
-        loss_func = SPARSE_VARIANTS[loss_func.name]
+        # the Pallas-kernel route when config.use_pallas_sparse is on
+        loss_func = sparse_variant(loss_func.name)
         init_coeff = np.zeros(dim, dtype=np.float64)
     else:
         init_coeff = np.zeros(X.shape[1], dtype=np.float64)

@@ -185,16 +185,68 @@ class KMeansModel(Model, KMeansModelParams):
             self.centroids, self.weights = loaded
 
 
-@partial(lazy_jit, static_argnames=("measure_name",))
-def _accumulate_batch(X, w, centroids, measure_name):
+def _accumulate_batch_impl(X, w, centroids, measure_name):
     """Per-batch Lloyd accumulation for out-of-core training: assign each
     row to its closest centroid and return (sums, counts) partials that the
-    host adds across the replayed stream. w masks shard-padding rows."""
+    host adds across the replayed stream. w masks shard-padding rows. The
+    un-jitted impl is shared with the whole-fit resident program, which
+    inlines the same accumulation inside its epoch loop."""
     measure = DistanceMeasure.get_instance(measure_name)
     dists = measure.pairwise(X, centroids)
     assign = jnp.argmin(dists, axis=1)
     one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype) * w[:, None]
     return one_hot.T @ X, jnp.sum(one_hot, axis=0)
+
+
+_accumulate_batch = lazy_jit(_accumulate_batch_impl, static_argnames=("measure_name",))
+
+
+def _lloyd_stream_whole_fit_impl(packed_all, init_centroids, init_counts, start_epoch, max_iter, measure_name):
+    """The whole out-of-core Lloyd fit as ONE resident program: the
+    stacked [X | w] stream batches (nb, rows, d+1) live in HBM (the device
+    epoch cache's contents staged once) and each epoch's inner loop
+    dynamic-slices batch partials in replay order — the same sequential
+    `sums + s` fold the host-driven loop performs, so centroids and counts
+    are bit-identical to it (the `optimization_barrier` materializes the
+    column views exactly as the per-batch staging path does). Requires
+    every batch bucketed to the SAME row count; ragged streams fall back
+    to the host-driven loop (dispatch.whole_fit_plan)."""
+    nb, _, dp1 = packed_all.shape
+    d = dp1 - 1
+    k = init_centroids.shape[0]
+
+    def batch_step(bi, acc):
+        sums, counts, centroids = acc
+        batch = lax.dynamic_index_in_dim(packed_all, bi, 0, False)
+        Xb, wb = lax.optimization_barrier((batch[:, :d], batch[:, d]))
+        s, c = _accumulate_batch_impl(Xb, wb, centroids, measure_name)
+        return sums + s, counts + c, centroids
+
+    def epoch_step(_, state):
+        centroids, _ = state
+        sums, counts, _ = lax.fori_loop(
+            0,
+            nb,
+            batch_step,
+            (
+                jnp.zeros((k, d), packed_all.dtype),
+                jnp.zeros((k,), packed_all.dtype),
+                centroids,
+            ),
+        )
+        centroids = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centroids
+        )
+        return centroids, counts
+
+    return lax.fori_loop(
+        start_epoch, max_iter, epoch_step, (init_centroids, init_counts)
+    )
+
+
+_lloyd_stream_whole_fit = lazy_jit(
+    _lloyd_stream_whole_fit_impl, static_argnames=("measure_name",)
+)
 
 
 def _sample_without_replacement(rng: np.random.RandomState, n: int, k: int) -> np.ndarray:
@@ -306,6 +358,11 @@ class KMeans(Estimator, KMeansParams):
             train = (
                 _lloyd_train_donating if dispatch.supports_donation() else _lloyd_train
             )
+        # the in-memory Lloyd loop has always been a whole-fit resident
+        # program (one dispatch, one packed readback); counted when the
+        # mode is on, like the fused SGD paths
+        if dispatch.whole_fit_enabled():
+            dispatch.account_whole_fit("lloyd")
         with tracing.span(
             "iteration.run", mode="device", epochs=self.get_max_iter()
         ):
@@ -463,6 +520,77 @@ class KMeans(Estimator, KMeansParams):
             _, keys, pos, has_gauss, cached = rng.get_state()
             return (np.asarray(keys), np.asarray([pos, has_gauss, cached], np.float64))
 
+        # Whole-fit resident program (config.whole_fit): all cached batches
+        # staged ONCE as a stacked (nb, rows, d+1) HBM array, the full
+        # Lloyd loop — inner per-batch accumulation in replay order, outer
+        # maxIter epochs — as one dispatch. Requires uniform bucketed batch
+        # shapes and the stack within the device-cache budget; a mid-fit
+        # checkpoint boundary keeps the host-driven loop (reason-counted).
+        from ...obs import tracing
+        from ...parallel import dispatch
+
+        targets = [
+            -(-(h2d.next_bucket(rows) if config.input_bucketing else rows) // shards)
+            * shards
+            for rows in batch_rows
+        ]
+        uniform = len(set(targets)) == 1
+        take_whole, _ = dispatch.whole_fit_plan(
+            start_epoch=start_epoch,
+            max_iter=self.get_max_iter(),
+            checkpoint_interval=interval if ckpt_dir is not None else None,
+            data_bytes=nb * max(targets) * (d + 1) * 4,
+            uniform_batches=uniform,
+        )
+        if take_whole and replay.stats.get("spilledSegments", 0) > 0:
+            # host cache spilled = demonstrably out-of-core scale: do not
+            # attempt the transient host stack / HBM-resident copy
+            dispatch.account_whole_fit_fallback("device_cache_budget")
+            take_whole = False
+        if take_whole:
+            target = targets[0]
+            stacked = np.empty((nb, target, d + 1), np.float32)
+            for bi, t in enumerate(replay):
+                Xb = np.asarray(as_dense_matrix(t.column(col)), dtype=np.float32)
+                rows = Xb.shape[0]
+                stacked[bi, :rows, :d] = Xb
+                stacked[bi, rows:, :d] = Xb[rows - 1 : rows]  # repeat-last-row pad
+                stacked[bi, :rows, d] = 1.0
+                stacked[bi, rows:, d] = 0.0  # weight-0: compute-invisible
+            packed_dev = h2d.stage_to_device(
+                stacked, NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS, None))
+            )
+            dispatch.account_whole_fit("lloyd")
+            with tracing.span(
+                "iteration.run", mode="whole_fit", epochs=self.get_max_iter()
+            ):
+                centroids, counts = dispatch.timed_dispatch(
+                    _lloyd_stream_whole_fit,
+                    packed_dev,
+                    centroids,
+                    counts,
+                    jnp.asarray(start_epoch, jnp.int32),
+                    jnp.asarray(self.get_max_iter(), jnp.int32),
+                    measure,
+                    start=start_epoch, end=self.get_max_iter(),
+                )
+            final_epoch = self.get_max_iter()
+            if (
+                ckpt_dir is not None
+                and final_epoch > start_epoch
+                and final_epoch % interval == 0
+            ):
+                _snapshot.save_job_snapshot(
+                    ckpt_dir,
+                    job_key,
+                    {"model": (centroids, counts), "rng": rng_section()},
+                    epoch=final_epoch,
+                    specs={"rng": "host"},
+                    meta={"numBatches": nb},
+                )
+            faults.tick("epoch")  # one drained readback = one tick
+            return self._finish_stream_fit(centroids, counts, replay)
+
         loader = CachedEpochLoader(stage)
         for epoch in range(start_epoch, self.get_max_iter()):
             sums = jnp.zeros((k, centroids.shape[1]), jnp.float32)
@@ -487,6 +615,11 @@ class KMeans(Estimator, KMeansParams):
                 )
             faults.tick("epoch")
 
+        return self._finish_stream_fit(centroids, counts, replay)
+
+    def _finish_stream_fit(self, centroids, counts, replay) -> KMeansModel:
+        """Shared tail of both stream arms: ONE packed readback of the
+        final (centroids, counts) and the model build."""
         from ...utils.packing import packed_device_get
 
         host_centroids, host_counts = packed_device_get(centroids, counts)

@@ -383,6 +383,76 @@ _sgd_chunk_donating = lazy_jit(
 )
 
 
+def _sgd_whole_fit_impl(X_b, y_b, w_b, carry, criteria, loss_func, hyper, pack_sharding):
+    """The ENTIRE checkpointed fit as ONE resident program: the epoch loop
+    to maxIter (per-epoch tol check inside the while condition — the exact
+    `_sgd_chunk_impl` body with chunk_end = maxIter), the one-extra final
+    model update, and the packed [coeff, criteria, epochs] result, so the
+    fit is one dispatch and one packed readback. The carry is ALSO
+    returned (device-resident) for the optional fit-end snapshot; the
+    `optimization_barrier` pins the final update to the materialized loop
+    carry, which is what makes the result bit-identical to the chunked
+    path's host-side `_final_update` (XLA may not fuse the update into the
+    loop epilogue and reassociate the last gradient application)."""
+    dtype = _feature_dtype(X_b)
+    max_iter, _, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+    carry, criteria, _ = _sgd_chunk_impl(
+        X_b, y_b, w_b, carry, criteria, loss_func, hyper, max_iter
+    )
+    coeff, grad, wsum, epochs = lax.optimization_barrier(carry)
+    final_coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    packed = _pack_train_result(final_coeff, criteria, epochs, None, pack_sharding)
+    return carry, criteria, packed
+
+
+_sgd_whole_fit = lazy_jit(
+    _sgd_whole_fit_impl, static_argnames=("loss_func", "pack_sharding")
+)
+
+
+def _sgd_stream_whole_fit_impl(packed_all, carry, criteria, loss_func, hyper, d, pack_sharding):
+    """The whole out-of-core fit as ONE resident program.
+
+    The stacked [X | y | w] stream segments (nb, b_pad, d+2) are the
+    in-program data source — the device epoch cache's contents as one
+    HBM-resident array, staged once. Each epoch dynamic-slices its batch
+    out of the stack and materializes the column views with an
+    `optimization_barrier`, mirroring how the host-driven loop receives
+    them from `_unpack_stream_batch` as standalone buffers — that plus
+    reusing `_stream_epoch_impl` verbatim (including its criteria guard)
+    makes every epoch bit-identical to the per-epoch dispatch pipeline;
+    the final update is barrier-pinned exactly as in `_sgd_whole_fit_impl`.
+    Returns (carry, criteria, packed [coeff, criteria, epochs])."""
+    dtype = _feature_dtype(packed_all)
+    max_iter, tol, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+    nb = packed_all.shape[0]
+
+    def cond(state):
+        c, crit = state
+        return jnp.logical_and(c[3] < max_iter, crit > tol)
+
+    def step(state):
+        c, crit = state
+        k = jnp.mod(c[3], nb)
+        batch = lax.dynamic_index_in_dim(packed_all, k, 0, False)
+        Xk, yk, wk = lax.optimization_barrier(
+            (batch[:, :d], batch[:, d], batch[:, d + 1])
+        )
+        c, crit, _ = _stream_epoch_impl(Xk, yk, wk, c, crit, loss_func, hyper)
+        return c, crit
+
+    carry, criteria = lax.while_loop(cond, step, (carry, criteria))
+    coeff, grad, wsum, epochs = lax.optimization_barrier(carry)
+    final_coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    packed = _pack_train_result(final_coeff, criteria, epochs, None, pack_sharding)
+    return carry, criteria, packed
+
+
+_sgd_stream_whole_fit = lazy_jit(
+    _sgd_stream_whole_fit_impl, static_argnames=("loss_func", "d", "pack_sharding")
+)
+
+
 def unpack_train_result(host: np.ndarray, d: int, has_flag: bool = False):
     """Host-side inverse of `_pack_train_result`: returns
     (flag_or_None, coeff[:d], criteria, epochs)."""
@@ -522,6 +592,13 @@ class SGD:
         d = int(np.shape(init_coeff)[0])
         from ..parallel import dispatch
 
+        # the in-memory fused paths below have been whole-fit programs
+        # since the dispatch pipeline landed (one dispatch, one packed
+        # readback, independent of the knob) — they count toward
+        # `dispatch.whole_fit` only when the mode is on, so chunked-vs-
+        # whole-fit BENCH comparisons see clean counters on the off side
+        if dispatch.whole_fit_enabled() and self.checkpoint_dir is None:
+            dispatch.account_whole_fit("sgd")
         if self._overlap_enabled():
             from ..parallel import overlap
 
@@ -736,6 +813,35 @@ class SGD:
             return _unpack_stream_batch(packed_dev, d, mat_sharding, row_sharding)
 
         interval = max(1, int(self.checkpoint_interval))
+
+        # Whole-fit resident program (config.whole_fit): stage the cached
+        # stream segments ONCE as a stacked HBM-resident (nb, b_pad, d+2)
+        # array — the device epoch cache's contents as the in-program data
+        # source — and run the entire fit as one dispatch + one packed
+        # readback. Falls back to the per-epoch dispatch pipeline when a
+        # checkpoint boundary lands mid-fit or the stack exceeds the
+        # device-cache budget (reason-counted fallbacks).
+        take_whole, _ = dispatch.whole_fit_plan(
+            start_epoch=epoch,
+            max_iter=self.max_iter,
+            checkpoint_interval=interval if self.checkpoint_dir is not None else None,
+            data_bytes=nb * b_pad * (d + 2) * np.dtype(self.dtype).itemsize,
+        )
+        if take_whole and cache.spilled_segments > 0:
+            # the host cache already spilled: the data is demonstrably
+            # out-of-core scale, so the transient host-side stack (and
+            # the HBM-resident copy) must not be attempted
+            dispatch.account_whole_fit_fallback("device_cache_budget")
+            take_whole = False
+        if take_whole:
+            try:
+                return self._stream_whole_fit(
+                    cache, segs, carry, epoch, criteria, loss_func, hyper,
+                    mesh, d, b_pad, interval, ckpt_meta,
+                )
+            finally:
+                cache.close()
+
         donate_ok = dispatch.supports_donation()
         queue = dispatch.DrainQueue(config.iteration_dispatch_depth)
         crit_dev = jnp.asarray(criteria, jnp.float32)
@@ -817,6 +923,74 @@ class SGD:
         finally:
             batch_iter.close()  # cancels speculative staging, stops the worker
             cache.close()
+        return np.asarray(coeff_h), final_crit, final_epoch, stats
+
+    def _stream_whole_fit(
+        self, cache, segs, carry, start_epoch, criteria, loss_func, hyper,
+        mesh, d, b_pad, interval, ckpt_meta,
+    ):
+        """Whole-fit arm of `optimize_stream` (see the call site): one
+        stacked upload, one resident program (`_sgd_stream_whole_fit`),
+        one packed readback — plus the fit-end snapshot when the cadence
+        lands exactly on maxIter. Bit-identical to the per-epoch path by
+        construction (pinned in tests/test_dispatch_pipeline.py)."""
+        from .. import config
+        from ..ckpt import faults
+        from ..obs import tracing
+        from ..parallel import dispatch
+        from ..utils.packing import packed_device_get
+
+        nb = len(segs)
+        stacked_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS, None))
+        stacked = np.empty((nb, b_pad, d + 2), np.dtype(self.dtype))
+        for k, seg in enumerate(segs):
+            stacked[k] = cache.read_array(seg)
+        packed_all = h2d.stage_to_device(stacked, stacked_sharding)
+        dispatch.account_whole_fit("stream")
+        with tracing.span(
+            "iteration.run", mode="whole_fit", epochs=self.max_iter
+        ):
+            carry, _, packed = dispatch.timed_dispatch(
+                _sgd_stream_whole_fit,
+                packed_all, carry, jnp.asarray(criteria, jnp.float32),
+                loss_func, hyper, d, self._pack_sharding(mesh),
+                start=start_epoch, end=self.max_iter,
+            )
+            (host,) = packed_device_get(packed, sync_kind="fit")
+            _, coeff_h, final_crit, final_epoch = unpack_train_result(
+                np.asarray(host), d
+            )
+            if (
+                self.checkpoint_dir is not None
+                and final_epoch > start_epoch
+                and final_epoch % interval == 0
+            ):
+                from ..ckpt import snapshot as _snapshot
+
+                _snapshot.save_job_snapshot(
+                    self.checkpoint_dir,
+                    self.checkpoint_key,
+                    {"model": carry},
+                    epoch=final_epoch,
+                    criteria=final_crit,
+                    meta={**ckpt_meta, "cacheCursor": final_epoch % nb},
+                )
+            faults.tick("epoch")  # one drained readback = one tick
+        stats = {
+            "numSegments": cache.num_segments,
+            "spilledSegments": cache.spilled_segments,
+            "memoryUsedBytes": cache.memory_used,
+            "deviceCache": {
+                "entries": nb,
+                "residentBytes": int(packed_all.nbytes),
+                "budgetBytes": (
+                    -1
+                    if config.device_cache_bytes is None
+                    else config.device_cache_bytes
+                ),
+            },
+            "wholeFit": True,
+        }
         return np.asarray(coeff_h), final_crit, final_epoch, stats
 
     def _optimize_flat_async(self, mesh, init_coeff, X, y, weights, loss_func, validate_labels):
@@ -943,6 +1117,45 @@ class SGD:
             carry = carry[:3] + (jnp.asarray(epoch, jnp.int32),)
 
         interval = max(1, int(self.checkpoint_interval))
+
+        # Whole-fit resident program (config.whole_fit): when no snapshot
+        # boundary lands strictly inside the remaining fit, the entire
+        # loop + final update + result pack run as ONE dispatch with ONE
+        # packed readback; a fit-end boundary is honored by snapshotting
+        # the returned carry after the drain. A mid-fit boundary falls
+        # back to the chunked path below (reason-counted).
+        take_whole, _ = dispatch.whole_fit_plan(
+            start_epoch=epoch, max_iter=self.max_iter, checkpoint_interval=interval
+        )
+        if take_whole:
+            dispatch.account_whole_fit("sgd")
+            crit_dev = jnp.asarray(criteria, jnp.float32)
+            with tracing.span(
+                "iteration.run", mode="whole_fit", epochs=self.max_iter
+            ):
+                carry, crit_dev, packed = dispatch.timed_dispatch(
+                    _sgd_whole_fit,
+                    X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
+                    self._pack_sharding(mesh),
+                    start=epoch, end=self.max_iter,
+                )
+                (host,) = packed_device_get(packed, sync_kind="fit")
+                _, coeff_h, final_crit, final_epoch = unpack_train_result(
+                    np.asarray(host), d
+                )
+                if final_epoch > epoch and final_epoch % interval == 0:
+                    _snapshot.save_job_snapshot(
+                        self.checkpoint_dir,
+                        self.checkpoint_key,
+                        {"model": carry},
+                        epoch=final_epoch,
+                        criteria=final_crit,
+                        specs={"model": carry_specs},
+                        meta=ckpt_meta,
+                    )
+                faults.tick("chunk")  # the whole fit is one drained chunk
+            return np.asarray(coeff_h), final_crit, final_epoch
+
         K = config.iteration_chunk_for(self.max_iter)
         donate_ok = dispatch.supports_donation()
         queue = dispatch.DrainQueue(config.iteration_dispatch_depth)

@@ -1,0 +1,115 @@
+"""Pallas kernels for the sparse padded-CSR gradient path.
+
+The sparse SGD losses (ops/losses.py `_sparse`) lower to an XLA gather
+(the masked per-row dot `sum(vals * coeff[safe], axis=1)`) and an XLA
+scatter-add (the gradient segment-sum `zeros.at[safe].add(...)`). Both are
+the ops XLA handles worst on TPU: gather/scatter have no MXU mapping and
+serialize on the scalar core, which is why SURVEY §7 reserves exactly this
+path for hand-written kernels. The two kernels here are the replacement,
+gated behind ``config.use_pallas_sparse``:
+
+- ``sparse_row_dots`` — per-row masked gather-and-sum. One block: indices,
+  values and the coefficient land in VMEM and the row reduction is a
+  vectorized multiply-sum, the memory-bound but contiguous layout the VPU
+  streams at line rate.
+- ``sparse_grad`` — the gradient segment-sum. Rows accumulate
+  SEQUENTIALLY (a `fori_loop` over the batch) and each row scatters
+  through a one-hot (nnz, d) mask contraction — dense VPU/MXU work
+  instead of a serialized scatter, and the row-major accumulation order
+  is exactly the order XLA's CPU scatter applies duplicate updates in.
+
+Bit-identity contract (pinned by tests/test_dispatch_pipeline.py): both
+kernels compute the SAME expressions as the lax path — identical masking
+(`-1`-index padding zeroed, out-of-range indices dropped like
+``mode="drop"``) and identical accumulation order — so a sparse fit with
+the flag on reproduces the lax fit bit for bit.
+
+On the CPU backend the kernels run with ``interpret=True`` so tier-1
+exercises them on every run; on TPU they compile through Mosaic. The
+single-block layout assumes the (B, nnz) batch and the (d,) coefficient
+fit VMEM — the padded-CSR training batches do; blocking the feature axis
+through the grid is the follow-up for beyond-VMEM dims (the coefficient
+would stay in HBM and DMA per block, docs/performance.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..utils.lazyjit import lazy_jit
+
+
+def _interpret() -> bool:
+    """Run the kernels through the Pallas interpreter off-TPU (CPU tier-1
+    exercises the kernel bodies bit-for-bit; Mosaic lowering is TPU-only)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _dot_kernel(idx_ref, val_ref, coeff_ref, out_ref):
+    """out[i] = sum_j vals[i,j] * coeff[safe[i,j]] with -1-index padding
+    masked to 0 — the exact expression of losses.sparse_dot."""
+    idx = idx_ref[...]
+    vals = val_ref[...]
+    coeff = coeff_ref[...]
+    valid = idx >= 0
+    safe = jnp.where(valid, idx, 0)
+    v = jnp.where(valid, vals, 0.0).astype(coeff.dtype)
+    out_ref[...] = jnp.sum(v * coeff[safe], axis=1)
+
+
+def _grad_kernel(idx_ref, val_ref, mult_ref, out_ref):
+    """grad = scatter-add of vals[i,j] * mult[i] at safe[i,j], accumulated
+    row-sequentially: row i's contribution is a one-hot (nnz, d) mask
+    contraction added to the running gradient — the same row-major update
+    order as the lax scatter, with out-of-range indices dropped."""
+    idx = idx_ref[...]
+    vals = val_ref[...]
+    mult = mult_ref[...]
+    d = out_ref.shape[0]
+    nnz = idx.shape[1]
+    valid = idx >= 0
+    safe = jnp.where(valid, idx, 0)
+    contrib = jnp.where(valid, vals, 0.0).astype(out_ref.dtype) * mult[:, None]
+
+    def row(i, acc):
+        cols = safe[i]
+        one_hot = lax.broadcasted_iota(jnp.int32, (nnz, d), 1) == cols[:, None]
+        one_hot = jnp.logical_and(one_hot, (cols < d)[:, None])  # mode="drop"
+        return acc + jnp.sum(
+            jnp.where(one_hot, contrib[i][:, None], 0.0), axis=0
+        )
+
+    out_ref[...] = lax.fori_loop(
+        0, idx.shape[0], row, jnp.zeros((d,), out_ref.dtype)
+    )
+
+
+@lazy_jit
+def sparse_row_dots(indices, values, coeff):
+    """Pallas masked per-row dot of padded-CSR features with `coeff` —
+    the drop-in replacement for the gather side of losses.sparse_dot."""
+    return pl.pallas_call(
+        _dot_kernel,
+        out_shape=jax.ShapeDtypeStruct((indices.shape[0],), coeff.dtype),
+        interpret=_interpret(),
+    )(indices, values, coeff)
+
+
+@lazy_jit
+def sparse_grad(indices, values, multiplier, coeff):
+    """Pallas segment-sum gradient: the drop-in replacement for the
+    `zeros_like(coeff).at[safe].add(vals * multiplier[:, None])` scatter.
+    `coeff` supplies the output shape/dtype only."""
+    return pl.pallas_call(
+        _grad_kernel,
+        out_shape=jax.ShapeDtypeStruct(coeff.shape, coeff.dtype),
+        interpret=_interpret(),
+    )(indices, values, multiplier)

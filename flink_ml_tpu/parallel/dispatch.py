@@ -62,6 +62,107 @@ def supports_donation() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# whole-fit resident programs: eligibility + accounting
+# ---------------------------------------------------------------------------
+#
+# Under `config.whole_fit == "auto"` the training loops compile the ENTIRE
+# fit — epoch loop to maxIter, per-epoch convergence check, final model
+# update, and the packed result — into one resident device program, so a
+# fit is exactly ONE dispatch and ONE packed readback regardless of the
+# chunk knobs. The compile key is the (shape-bucket x packed-hyperparam
+# layout): data shapes and the loss are jit static structure, while the
+# packed f32 hyper vector, maxIter, tol, and the carry are runtime
+# operands — repeated fits at one shape bucket re-enter one executable.
+# `whole_fit_plan` is the central eligibility decision; a fit that cannot
+# be resident falls back to the chunked DrainQueue path below, counted per
+# reason (docs/performance.md "Whole-fit resident programs").
+
+#: The fallback-reason label set (`dispatch.whole_fit_fallback.<reason>`):
+#: - checkpoint_interval: a snapshot boundary lands strictly inside the
+#:   fit — the chunked path must surface the carry at that epoch.
+#: - device_cache_budget: the stacked stream data source does not fit the
+#:   `config.device_cache_bytes` HBM budget (or the cache is disabled).
+#: - ragged_batches: stream batches bucket to different row counts, so no
+#:   single stacked (nb, rows, cols) array exists to index in-program.
+#: - listener: a per-epoch listener needs every (epoch, carry) pair on
+#:   the host — resident programs have no per-epoch host boundary.
+WHOLE_FIT_FALLBACK_REASONS = (
+    "checkpoint_interval",
+    "device_cache_budget",
+    "ragged_batches",
+    "listener",
+)
+
+
+def whole_fit_enabled() -> bool:
+    """Is the whole-fit resident-program mode on (`config.whole_fit`)?"""
+    from .. import config
+
+    return config.whole_fit == "auto"
+
+
+def account_whole_fit(kind: str = "fit") -> None:
+    """Count a fit taking the resident-program path (`dispatch.whole_fit`
+    + a per-loop kind: sgd / stream / lloyd / iterate)."""
+    metrics.inc_counter("dispatch.whole_fit")
+    metrics.inc_counter(f"dispatch.whole_fit.{kind}")
+
+
+def account_whole_fit_fallback(reason: str) -> None:
+    """Count a whole-fit-eligible loop falling back to the chunked path,
+    labelled with WHY (`dispatch.whole_fit_fallback.<reason>`) — the BENCH
+    runner surfaces the totals, so a config change that silently knocks
+    fits off the resident path shows up as a counter jump."""
+    metrics.inc_counter("dispatch.whole_fit_fallback")
+    metrics.inc_counter(f"dispatch.whole_fit_fallback.{reason}")
+    if timeline.enabled():
+        timeline.record_instant(
+            timeline.LANE_DISPATCH, "whole_fit.fallback", reason=reason
+        )
+
+
+def whole_fit_plan(
+    *,
+    start_epoch: int,
+    max_iter: int,
+    checkpoint_interval: Optional[int] = None,
+    data_bytes: Optional[int] = None,
+    uniform_batches: bool = True,
+    listener: bool = False,
+) -> Tuple[bool, Optional[str]]:
+    """The central whole-fit eligibility decision: (take, fallback_reason).
+
+    `checkpoint_interval` is the snapshot cadence when checkpointing is
+    active (None = no checkpointing): a boundary strictly inside
+    (start_epoch, max_iter) forces the chunked path; a boundary AT fit end
+    stays whole-fit — the loop snapshots once after its single readback.
+    `data_bytes` is the stacked stream data source's size, checked against
+    the device-cache budget. Returns (False, None) with NO fallback count
+    when the mode is off — fallbacks are only meaningful for fits that
+    asked to be resident."""
+    if not whole_fit_enabled():
+        return False, None
+    reason = None
+    if listener:
+        reason = "listener"
+    if reason is None and checkpoint_interval is not None:
+        boundary = next_boundary(start_epoch, checkpoint_interval)
+        if boundary is not None and boundary < max_iter:
+            reason = "checkpoint_interval"
+    if reason is None and not uniform_batches:
+        reason = "ragged_batches"
+    if reason is None and data_bytes is not None:
+        from ..data.devicecache import within_device_budget
+
+        if not within_device_budget(data_bytes):
+            reason = "device_cache_budget"
+    if reason is not None:
+        account_whole_fit_fallback(reason)
+        return False, reason
+    return True, None
+
+
+# ---------------------------------------------------------------------------
 # chunk runner: K epochs of `body` as one program
 # ---------------------------------------------------------------------------
 

@@ -261,6 +261,23 @@ def _iterate_host_driven(
 
     per_epoch = listener is not None
     K = 1 if per_epoch else config.iteration_chunk_for(max_iter, chunk_size)
+    # Whole-fit resident program (config.whole_fit): with no listener and
+    # no snapshot boundary strictly inside the remaining loop, the chunk
+    # program covers the ENTIRE fit (K = remaining epochs) — one dispatch,
+    # one packed readback, and the existing fit-end-boundary snapshot
+    # logic below still fires on the retained carry. A listener or a
+    # mid-fit boundary falls back to the chunked path (reason-counted).
+    take_whole, _ = dispatch.whole_fit_plan(
+        start_epoch=epoch,
+        max_iter=max_iter,
+        checkpoint_interval=(
+            checkpoint_interval if checkpoint_dir is not None else None
+        ),
+        listener=per_epoch,
+    )
+    if take_whole:
+        dispatch.account_whole_fit("iterate")
+        K = max(1, max_iter - epoch)
     runner = dispatch.chunk_runner(body)
     donate_ok = dispatch.supports_donation()
     tol_value = jnp.asarray(-jnp.inf if tol is None else float(tol), jnp.float32)

@@ -63,6 +63,11 @@ _LOWER_BETTER = (
     "dispatchgapms",
     "reldiff",
     "hostsynccount",
+    # whole-fit resident programs: dispatches per entry and fits knocked
+    # off the resident path are regressions in the same direction as
+    # hostSyncCount (docs/performance.md "Whole-fit resident programs")
+    "dispatchcount",
+    "wholefitfallbacks",
 )
 _HIGHER_BETTER = (
     "throughput",

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""smoke_fit_timeline — one chunked traced LR fit for the CI flight
-recorder artifact.
+"""smoke_fit_timeline — traced LR fits for the CI flight-recorder artifact.
 
-Runs a small chunked (checkpointed) SGD fit with the timeline ring
-enabled, dumps the event JSONL (FLINK_ML_TPU_TIMELINE_FILE wins if set),
-and prints the dispatch-wall attribution. CI renders the dump with
-scripts/obs_timeline.py and uploads both as the per-run Perfetto
-artifact (docs/observability.md).
+Runs TWO small SGD fits with the timeline ring enabled and dumps each
+event JSONL (FLINK_ML_TPU_TIMELINE_FILE wins for the first if set):
+
+1. a chunked (checkpointed, `whole_fit` off) fit — the multi-chunk
+   dispatch pipeline timeline, -> EVENTS_OUT.jsonl;
+2. the SAME fit through the whole-fit resident program (`whole_fit`
+   auto, fit-end-only snapshot cadence) — the single-dispatch timeline,
+   -> EVENTS_OUT base + "-wholefit.jsonl".
+
+CI renders both with scripts/obs_timeline.py and uploads them as the
+per-run Perfetto artifacts (docs/observability.md), so the one-dispatch
+claim is visually checkable on every run.
 
 Usage: python scripts/smoke_fit_timeline.py [EVENTS_OUT.jsonl]
 """
@@ -18,34 +24,29 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(argv):
-    out_path = argv[0] if argv else os.environ.get(
-        "FLINK_ML_TPU_TIMELINE_FILE", "timeline-events.jsonl"
-    )
+def _fit(timeline, config, out_path, mode, checkpoint_interval, label):
     import numpy as np
 
-    from flink_ml_tpu import config
-    from flink_ml_tpu.obs import timeline
     from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
     from flink_ml_tpu.ops.optimizer import SGD
 
-    timeline.configure(ring_size=65536)
-    config.iteration_chunk_size = 8
     rng = np.random.RandomState(3)
     X = rng.randn(400, 8).astype(np.float32)
     y = (X @ np.linspace(1, -1, 8) > 0).astype(np.float32)
-    with tempfile.TemporaryDirectory() as ckpt_dir:
+    timeline.configure(ring_size=65536)
+    with config.whole_fit_mode(mode), tempfile.TemporaryDirectory() as ckpt_dir:
         sgd = SGD(
             max_iter=56,
             global_batch_size=100,
             tol=0.0,
             checkpoint_dir=ckpt_dir,
-            checkpoint_interval=8,
+            checkpoint_interval=checkpoint_interval,
         )
         _, _, epochs = sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
     n = timeline.dump_jsonl(out_path)
     attr = timeline.dispatch_attribution()
-    print(f"smoke fit: {epochs} epochs, {n} timeline events -> {out_path}")
+    timeline.configure()  # reset the ring between the two fits
+    print(f"smoke fit ({label}): {epochs} epochs, {n} timeline events -> {out_path}")
     if attr:
         print(
             "attribution: "
@@ -55,6 +56,28 @@ def main(argv):
             )
             + f" over {attr['gapCount']} chunks"
         )
+    return attr
+
+
+def main(argv):
+    out_path = argv[0] if argv else os.environ.get(
+        "FLINK_ML_TPU_TIMELINE_FILE", "timeline-events.jsonl"
+    )
+    from flink_ml_tpu import config
+    from flink_ml_tpu.obs import timeline
+
+    config.iteration_chunk_size = 8
+    _fit(timeline, config, out_path, "off", 8, "chunked")
+
+    base, ext = os.path.splitext(out_path)
+    whole_path = f"{base}-wholefit{ext or '.jsonl'}"
+    attr = _fit(timeline, config, whole_path, "auto", 56, "whole-fit")
+    if attr and attr.get("gapCount", 0) != 1:
+        print(
+            f"ERROR: whole-fit timeline recorded {attr.get('gapCount')} "
+            "dispatch->drain cycles, expected the single-dispatch timeline"
+        )
+        return 1
     return 0
 
 

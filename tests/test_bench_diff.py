@@ -53,6 +53,48 @@ def test_metric_directions():
     assert bench_diff.metric_direction("vsPublishedBaseline") == "higher"
     assert bench_diff.metric_direction("numChips") is None
     assert bench_diff.metric_direction("h2dBytes") is None  # info by default
+    # the whole-fit dispatch gate: sync/dispatch counts and host-dispatch
+    # wall are direction-gated, so a resident-path regression fails CI
+    assert bench_diff.metric_direction("hostDispatchMs") == "lower"
+    assert bench_diff.metric_direction("dispatchCount") == "lower"
+    assert bench_diff.metric_direction("wholeFitFallbacks") == "lower"
+    # the chunked reference side of wholeFitDispatch is informational
+    assert bench_diff.metric_direction("hostSyncCountChunked") is None
+    assert bench_diff.metric_direction("dispatchCountChunked") is None
+
+
+def test_whole_fit_dispatch_regressions_fail_gate():
+    """A whole-fit entry whose fit stops being resident (hostSyncCount
+    1 -> 61, dispatchCount 1 -> 60, hostDispatchMs up) must REGRESS even
+    at the default threshold — these leaves are gated by direction, no
+    explicit --rule needed."""
+    old = {
+        "wholeFitDispatch": {
+            "hostSyncCount": 1.0,
+            "dispatchCount": 1.0,
+            "hostDispatchMs": 6.0,
+        }
+    }
+    new = {
+        "wholeFitDispatch": {
+            "hostSyncCount": 61.0,
+            "dispatchCount": 60.0,
+            "hostDispatchMs": 300.0,
+        }
+    }
+    rows = bench_diff.diff_entries(old, new, 0.15, [])
+    verdicts = {r["path"]: r["verdict"] for r in rows}
+    assert verdicts["wholeFitDispatch.hostSyncCount"] == "REGRESSED"
+    assert verdicts["wholeFitDispatch.dispatchCount"] == "REGRESSED"
+    assert verdicts["wholeFitDispatch.hostDispatchMs"] == "REGRESSED"
+    # the zero-tolerance CI rule pins hostSyncCount exactly
+    strict = bench_diff.diff_entries(
+        {"wholeFitDispatch": {"hostSyncCount": 1.0}},
+        {"wholeFitDispatch": {"hostSyncCount": 2.0}},
+        0.15,
+        [("*.hostSyncCount", 0.0)],
+    )
+    assert strict[0]["verdict"] == "REGRESSED"
 
 
 def test_cold_time_informational_by_default():

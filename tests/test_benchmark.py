@@ -89,6 +89,7 @@ class TestRunner:
             "name", "totalTimeMs", "inputRecordNum", "inputThroughput",
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
+            "wholeFitCount", "wholeFitFallbacks",
             "hostDispatchMs", "dispatchGapMs", "gapCount", "dispatchAttribution",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",

@@ -256,37 +256,42 @@ class TestHostSyncBudget:
         assert counters.get("iteration.host_sync", 0) == 1 <= budget
 
     def test_chunked_lr_fit_within_budget(self, tmp_path, chunk_size):
-        for k in [4, 32, self.MAX_ITER]:
-            config.iteration_chunk_size = k
-            X, y = _dense_problem()
-            sgd = SGD(
-                max_iter=self.MAX_ITER,
-                global_batch_size=100,
-                tol=0.0,
-                checkpoint_dir=str(tmp_path / f"budget_{k}"),
-                checkpoint_interval=self.MAX_ITER,  # snapshot only at the end
-            )
-            counters = self._delta(
-                lambda: sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
-            )
-            budget = math.ceil(self.MAX_ITER / k) + 2
-            drains = counters.get("iteration.host_sync.drain", 0)
-            assert drains <= budget, f"K={k}: {drains} drains > budget {budget}"
-            # total syncs = drains + 1 end checkpoint + 1 packed fit readback
-            assert counters.get("iteration.host_sync", 0) <= budget + 2
+        # whole_fit off: this pins the CHUNKED path's drain budget (the
+        # fit-end-only snapshot cadence would otherwise go resident)
+        with config.whole_fit_mode("off"):
+            for k in [4, 32, self.MAX_ITER]:
+                config.iteration_chunk_size = k
+                X, y = _dense_problem()
+                sgd = SGD(
+                    max_iter=self.MAX_ITER,
+                    global_batch_size=100,
+                    tol=0.0,
+                    checkpoint_dir=str(tmp_path / f"budget_{k}"),
+                    checkpoint_interval=self.MAX_ITER,  # snapshot only at the end
+                )
+                counters = self._delta(
+                    lambda: sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+                )
+                budget = math.ceil(self.MAX_ITER / k) + 2
+                drains = counters.get("iteration.host_sync.drain", 0)
+                assert drains <= budget, f"K={k}: {drains} drains > budget {budget}"
+                # total syncs = drains + 1 end checkpoint + 1 packed fit readback
+                assert counters.get("iteration.host_sync", 0) <= budget + 2
 
     def test_per_epoch_regression_guard(self, tmp_path, chunk_size):
         """K=1 (the old behavior) really is O(maxIter) — the counter
-        measures what it claims, so a regression cannot hide in it."""
+        measures what it claims, so a regression cannot hide in it.
+        whole_fit off: the resident path would collapse this to 1."""
         config.iteration_chunk_size = 1
         X, y = _dense_problem()
         sgd = SGD(
             max_iter=50, global_batch_size=100, tol=0.0,
             checkpoint_dir=str(tmp_path / "k1"), checkpoint_interval=50,
         )
-        counters = self._delta(
-            lambda: sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
-        )
+        with config.whole_fit_mode("off"):
+            counters = self._delta(
+                lambda: sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+            )
         assert counters.get("iteration.host_sync.drain", 0) == 50
 
 
@@ -339,3 +344,321 @@ class TestDispatchPrimitives:
         q.drain_all()
         delta = metrics.snapshot_delta(before, metrics.snapshot())["counters"]
         assert delta.get("iteration.host_sync.drain", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# whole-fit resident programs (config.whole_fit, docs/performance.md)
+# ---------------------------------------------------------------------------
+
+def _counters(fn):
+    before = metrics.snapshot()
+    out = fn()
+    return out, metrics.snapshot_delta(before, metrics.snapshot())["counters"]
+
+
+def _stream_chunks(X, y, chunk=160):
+    for i in range(0, X.shape[0], chunk):
+        yield X[i : i + chunk], y[i : i + chunk], None
+
+
+WHOLE_FIT_ITERS = [1, 7, 200]
+
+
+class TestWholeFitParity:
+    """The whole-fit resident path must be INVISIBLE: carries, stop
+    epochs, and final packs bit-identical to the chunked/per-epoch
+    reference (`whole_fit` off) for every covered loop, including
+    tol-early-stop — while collapsing the fit to one dispatch + one
+    packed readback."""
+
+    def _ckpt_fit(self, X, y, loss, d, tmp_path, tag, max_iter, tol=0.0):
+        sgd = SGD(
+            max_iter=max_iter,
+            global_batch_size=100,
+            tol=tol,
+            checkpoint_dir=str(tmp_path / tag),
+            checkpoint_key=tag,
+            checkpoint_interval=max_iter,  # fit-end boundary only
+        )
+        return sgd.optimize(np.zeros(d), X, y, None, loss)
+
+    @pytest.mark.parametrize("max_iter", WHOLE_FIT_ITERS)
+    def test_checkpointed_dense_sgd(self, tmp_path, max_iter):
+        X, y = _dense_problem()
+        with config.whole_fit_mode("off"):
+            ref = self._ckpt_fit(X, y, BINARY_LOGISTIC_LOSS, 8, tmp_path, "off", max_iter)
+        got, counters = _counters(
+            lambda: self._ckpt_fit(X, y, BINARY_LOGISTIC_LOSS, 8, tmp_path, "on", max_iter)
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[1] == ref[1] and got[2] == ref[2] == max_iter
+        assert counters.get("dispatch.whole_fit.sgd", 0) == 1
+        assert counters.get("iteration.host_sync.drain", 0) == 0
+        assert counters.get("iteration.host_sync.fit", 0) == 1
+
+    @pytest.mark.parametrize("max_iter", WHOLE_FIT_ITERS)
+    def test_checkpointed_sparse_sgd(self, tmp_path, max_iter):
+        Xs, y = _sparse_problem()
+        with config.whole_fit_mode("off"):
+            ref = self._ckpt_fit(
+                Xs, y, SPARSE_BINARY_LOGISTIC_LOSS, 12, tmp_path, "soff", max_iter
+            )
+        got = self._ckpt_fit(
+            Xs, y, SPARSE_BINARY_LOGISTIC_LOSS, 12, tmp_path, "son", max_iter
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[2] == ref[2]
+
+    def test_checkpointed_tol_early_stop(self, tmp_path):
+        """tol fires mid-fit: the resident program's per-epoch convergence
+        check must land on the chunked path's exact stop epoch."""
+        X, y = _dense_problem()
+        probe = self._ckpt_fit(X, y, BINARY_LOGISTIC_LOSS, 8, tmp_path, "probe", 10)
+        tol = float(probe[1])
+        with config.whole_fit_mode("off"):
+            ref = self._ckpt_fit(X, y, BINARY_LOGISTIC_LOSS, 8, tmp_path, "toff", 40, tol)
+        assert 0 < ref[2] < 40, "tol must fire mid-run for this test to bite"
+        got = self._ckpt_fit(X, y, BINARY_LOGISTIC_LOSS, 8, tmp_path, "ton", 40, tol)
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[1] == ref[1] and got[2] == ref[2]
+
+    @pytest.mark.parametrize("max_iter", WHOLE_FIT_ITERS)
+    def test_stream_sgd(self, max_iter):
+        X, y = _dense_problem()
+        sgd = lambda: SGD(max_iter=max_iter, global_batch_size=100, tol=0.0)
+        with config.whole_fit_mode("off"):
+            ref = sgd().optimize_stream(
+                np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+            )
+        got, counters = _counters(
+            lambda: sgd().optimize_stream(
+                np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+            )
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[1] == ref[1] and got[2] == ref[2] == max_iter
+        assert got[3]["wholeFit"] is True
+        assert counters.get("dispatch.whole_fit.stream", 0) == 1
+        # THE acceptance pin: the whole out-of-core fit is one blocking
+        # host<->device sync — one dispatch, one packed readback
+        assert counters.get("iteration.host_sync", 0) == 1
+
+    def test_stream_sgd_tol_early_stop(self):
+        X, y = _dense_problem()
+        with config.whole_fit_mode("off"):
+            probe = SGD(max_iter=10, global_batch_size=100, tol=0.0).optimize_stream(
+                np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+            )
+            tol = float(probe[1])
+            ref = SGD(max_iter=40, global_batch_size=100, tol=tol).optimize_stream(
+                np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+            )
+        assert 0 < ref[2] < 40
+        got = SGD(max_iter=40, global_batch_size=100, tol=tol).optimize_stream(
+            np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[1] == ref[1] and got[2] == ref[2]
+
+    def test_stream_lloyd(self):
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+        from flink_ml_tpu.table import StreamTable
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(320, 3).astype(np.float64)
+        batches = [Table({"features": X[i : i + 64]}) for i in range(0, 320, 64)]
+        km = lambda: (
+            KMeans().set_k(4).set_seed(11).set_max_iter(7)
+        )
+        with config.whole_fit_mode("off"):
+            ref = km().fit(StreamTable.from_batches(batches))
+        got, counters = _counters(
+            lambda: km().fit(StreamTable.from_batches(batches))
+        )
+        np.testing.assert_array_equal(got.centroids, ref.centroids)
+        np.testing.assert_array_equal(got.weights, ref.weights)
+        assert counters.get("dispatch.whole_fit.lloyd", 0) == 1
+        assert counters.get("iteration.host_sync", 0) == 1
+
+    def test_iterate_bounded_whole_fit(self, tmp_path):
+        """The generic runtime: fit-end-only snapshot cadence goes
+        resident (one dispatch + one drain), bit-identical to chunked."""
+        body = TestIterateBoundedChunked._lloyd_body(
+            jnp.asarray(np.random.RandomState(0).randn(60, 3).astype(np.float32))
+        )
+        init = jnp.zeros((4, 3))
+        with config.whole_fit_mode("off"):
+            ref = iterate_bounded(
+                body, init, max_iter=25, tol=1e-4,
+                checkpoint_dir=str(tmp_path / "off"), checkpoint_interval=25,
+            )
+        got, counters = _counters(
+            lambda: iterate_bounded(
+                body, init, max_iter=25, tol=1e-4,
+                checkpoint_dir=str(tmp_path / "on"), checkpoint_interval=25,
+            )
+        )
+        np.testing.assert_array_equal(np.asarray(got.carry), np.asarray(ref.carry))
+        assert got.num_epochs == ref.num_epochs
+        assert counters.get("dispatch.whole_fit.iterate", 0) == 1
+        assert counters.get("iteration.host_sync.drain", 0) == 1
+
+
+class TestWholeFitFallbacks:
+    """Ineligible fits fall back to the chunked path, counted per reason
+    (`dispatch.whole_fit_fallback.<reason>`) — and still compute the
+    reference result."""
+
+    def test_mid_fit_checkpoint_interval_falls_back(self, tmp_path):
+        X, y = _dense_problem()
+        sgd = SGD(
+            max_iter=12, global_batch_size=100, tol=0.0,
+            checkpoint_dir=str(tmp_path / "mid"), checkpoint_key="mid",
+            checkpoint_interval=4,
+        )
+        _, counters = _counters(
+            lambda: sgd.optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+        )
+        assert counters.get("dispatch.whole_fit_fallback.checkpoint_interval", 0) == 1
+        assert counters.get("dispatch.whole_fit.sgd", 0) == 0
+        assert counters.get("iteration.host_sync.drain", 0) >= 1
+
+    def test_stream_over_budget_falls_back(self):
+        X, y = _dense_problem()
+        with config.device_cache_budget(1024):  # stack ≫ 1KB
+            got, counters = _counters(
+                lambda: SGD(
+                    max_iter=6, global_batch_size=100, tol=0.0
+                ).optimize_stream(np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS)
+            )
+        assert counters.get("dispatch.whole_fit_fallback.device_cache_budget", 0) == 1
+        assert "wholeFit" not in got[3]
+        with config.whole_fit_mode("off"):
+            ref = SGD(max_iter=6, global_batch_size=100, tol=0.0).optimize_stream(
+                np.zeros(8), _stream_chunks(X, y), BINARY_LOGISTIC_LOSS
+            )
+        np.testing.assert_array_equal(got[0], ref[0])
+
+    def test_ragged_kmeans_stream_falls_back(self):
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+        from flink_ml_tpu.table import StreamTable
+
+        rng = np.random.RandomState(1)
+        # 64-row and 200-row batches bucket to different row counts
+        batches = [
+            Table({"features": rng.randn(rows, 3).astype(np.float64)})
+            for rows in (64, 200, 64)
+        ]
+        km = KMeans().set_k(3).set_seed(5).set_max_iter(4)
+        _, counters = _counters(
+            lambda: km.fit(StreamTable.from_batches(batches))
+        )
+        assert counters.get("dispatch.whole_fit_fallback.ragged_batches", 0) == 1
+        assert counters.get("dispatch.whole_fit.lloyd", 0) == 0
+
+    def test_listener_falls_back(self):
+        from flink_ml_tpu.parallel.iteration import IterationListener
+
+        seen = []
+
+        class Rec(IterationListener):
+            def on_epoch_watermark_incremented(self, epoch, carry):
+                seen.append(epoch)
+
+        body = lambda c, e: (c + 1.0, jnp.asarray(1.0, jnp.float32))
+        _, counters = _counters(
+            lambda: iterate_bounded(
+                body, jnp.zeros(2), max_iter=3, tol=None, listener=Rec()
+            )
+        )
+        assert seen == [1, 2, 3]
+        assert counters.get("dispatch.whole_fit_fallback.listener", 0) == 1
+
+    def test_off_mode_counts_nothing(self, tmp_path):
+        X, y = _dense_problem()
+        with config.whole_fit_mode("off"):
+            _, counters = _counters(
+                lambda: SGD(
+                    max_iter=6, global_batch_size=100, tol=0.0,
+                    checkpoint_dir=str(tmp_path / "off2"), checkpoint_key="o",
+                ).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+            )
+        assert counters.get("dispatch.whole_fit", 0) == 0
+        assert counters.get("dispatch.whole_fit_fallback", 0) == 0
+
+
+class TestPallasSparseKernels:
+    """ops/sparsekernels.py: the Pallas gather-dot and segment-sum must be
+    bit-identical to the lax path — same masking, same accumulation
+    order — and the flag routes fits through them."""
+
+    def _matrix(self, n=64, d=24, nnz=5, seed=9):
+        rng = np.random.RandomState(seed)
+        indices = np.stack(
+            [rng.choice(d, nnz, replace=False) for _ in range(n)]
+        ).astype(np.int32)
+        values = rng.randn(n, nnz).astype(np.float32)
+        indices[-3:, -2:] = -1  # padding rows exercise the mask
+        return indices, values
+
+    def test_row_dots_bit_identical(self):
+        from flink_ml_tpu.ops.losses import sparse_dot
+        from flink_ml_tpu.ops.sparsekernels import sparse_row_dots
+
+        indices, values = self._matrix()
+        coeff = jnp.asarray(np.random.RandomState(2).randn(24).astype(np.float32))
+        ref, _, _ = sparse_dot(jnp.asarray(indices), jnp.asarray(values), coeff)
+        got = sparse_row_dots(jnp.asarray(indices), jnp.asarray(values), coeff)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_grad_matches_lax_segment_sum(self):
+        from flink_ml_tpu.ops.sparsekernels import sparse_grad
+
+        indices, values = self._matrix()
+        d = 24
+        mult = jnp.asarray(np.random.RandomState(4).randn(64).astype(np.float32))
+        coeff = jnp.zeros((d,), jnp.float32)
+        valid = indices >= 0
+        safe = np.where(valid, indices, 0)
+        vals = np.where(valid, values, 0.0)
+        ref = (
+            jnp.zeros_like(coeff)
+            .at[jnp.asarray(safe)]
+            .add(jnp.asarray(vals) * mult[:, None], mode="drop")
+        )
+        got = sparse_grad(jnp.asarray(indices), jnp.asarray(values), mult, coeff)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_sparse_fit_bit_identical_and_flag_routes(self):
+        from flink_ml_tpu.ops.losses import (
+            PALLAS_SPARSE_BINARY_LOGISTIC_LOSS,
+            sparse_variant,
+        )
+        from flink_ml_tpu.parallel import mesh as mesh_lib
+
+        assert sparse_variant("binary_logistic").name == "sparse_binary_logistic"
+        with config.pallas_sparse_mode():
+            assert (
+                sparse_variant("binary_logistic")
+                is PALLAS_SPARSE_BINARY_LOGISTIC_LOSS
+            )
+        Xs, y = _sparse_problem()
+        # single data shard: the whole fit must be BIT-identical (same
+        # masking + accumulation order). Across a sharded mesh GSPMD
+        # partitions the two formulations with different cross-shard
+        # reduction orders (the documented cross-shard caveat), so the
+        # default-mesh check is allclose.
+        mesh1 = mesh_lib.create_mesh(
+            (mesh_lib.DATA_AXIS,), devices=jax.devices()[:1]
+        )
+        sgd = lambda loss, mesh: SGD(
+            max_iter=9, global_batch_size=32, tol=0.0
+        ).optimize(np.zeros(12), Xs, y, None, loss, mesh=mesh)
+        ref = sgd(SPARSE_BINARY_LOGISTIC_LOSS, mesh1)
+        got = sgd(PALLAS_SPARSE_BINARY_LOGISTIC_LOSS, mesh1)
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert got[1] == ref[1] and got[2] == ref[2]
+        ref8 = sgd(SPARSE_BINARY_LOGISTIC_LOSS, None)
+        got8 = sgd(PALLAS_SPARSE_BINARY_LOGISTIC_LOSS, None)
+        np.testing.assert_allclose(got8[0], ref8[0], rtol=1e-6, atol=1e-7)
+        assert got8[2] == ref8[2]

@@ -448,3 +448,121 @@ def test_flaky_datacache_read_inside_stream_fit_bit_identical(tmp_path):
                 _sgd(max_iter=6).optimize_stream(
                     None, chunks(), BINARY_LOGISTIC_LOSS
                 )
+
+
+# ---------------------------------------------------------------------------
+# whole-fit resident programs x checkpointing (config.whole_fit)
+# ---------------------------------------------------------------------------
+
+def _whole_fit_sgd(ckpt, max_iter, interval, key="wf"):
+    return SGD(
+        max_iter=max_iter, global_batch_size=96, tol=0.0,
+        checkpoint_dir=ckpt, checkpoint_key=key, checkpoint_interval=interval,
+    )
+
+
+def test_whole_fit_kill_after_end_snapshot_resumes_bit_identical(tmp_path):
+    """Whole-fit + checkpoint_job_key: a fit-end-only cadence stays on the
+    resident path and snapshots AFTER its single packed readback — a kill
+    at the (one) chunk tick lands after the snapshot commit, and the
+    resumed run restores the completed carry and reproduces the
+    uninterrupted result bit for bit."""
+    from flink_ml_tpu.utils import metrics
+
+    X, y = _dense_problem()
+    ref = str(tmp_path / "ref")
+    expected, _, _ = _whole_fit_sgd(ref, 12, 12).optimize(
+        np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+    )
+
+    ckpt = str(tmp_path / "kill")
+    before = metrics.snapshot()
+    with faults.inject("chunk", after=1) as plan:
+        with pytest.raises(InjectedFault):
+            _whole_fit_sgd(ckpt, 12, 12).optimize(
+                np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+            )
+    assert plan.fired
+    delta = metrics.snapshot_delta(before, metrics.snapshot())["counters"]
+    assert delta.get("dispatch.whole_fit.sgd", 0) == 1  # resident path taken
+
+    got, _, epochs = _whole_fit_sgd(ckpt, 12, 12).optimize(
+        np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+    )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_whole_fit_resume_extends_max_iter_bit_identical(tmp_path):
+    """The canonical resume pattern on the resident path: train to 6 with
+    a fit-end snapshot, resume with maxIter=12 — the second whole-fit
+    program starts from the restored carry and lands on the
+    uninterrupted 12-epoch run's exact result."""
+    X, y = _dense_problem()
+    ref = str(tmp_path / "ref")
+    expected, _, _ = _whole_fit_sgd(ref, 12, 12).optimize(
+        np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+    )
+
+    ckpt = str(tmp_path / "resume")
+    _whole_fit_sgd(ckpt, 6, 6).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+    got, _, epochs = _whole_fit_sgd(ckpt, 12, 12).optimize(
+        np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+    )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_whole_fit_mid_fit_cadence_falls_back_and_preserves_kill_resume(tmp_path):
+    """A mid-fit checkpoint cadence must NOT go resident: the fallback is
+    visible in obs (`dispatch.whole_fit_fallback.checkpoint_interval`) and
+    the chunked path's kill->resume bit-identity (PR 6) is preserved
+    unchanged under whole_fit auto."""
+    from flink_ml_tpu.utils import metrics
+
+    X, y = _dense_problem()
+    ref = str(tmp_path / "ref")
+    expected, _, _ = _sgd(ref).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+
+    ckpt = str(tmp_path / "kill")
+    before = metrics.snapshot()
+    with faults.inject("chunk", after=3) as plan:
+        with pytest.raises(InjectedFault):
+            _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+    assert plan.fired
+    delta = metrics.snapshot_delta(before, metrics.snapshot())["counters"]
+    assert delta.get("dispatch.whole_fit_fallback.checkpoint_interval", 0) == 1
+    assert delta.get("dispatch.whole_fit.sgd", 0) == 0
+
+    got, _, epochs = _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_whole_fit_stream_end_snapshot_resume(tmp_path):
+    """Stream whole-fit + fit-end cadence: the snapshot written after the
+    single readback restores into a longer rerun bit-identically (the
+    cacheCursor meta keeps the epoch->segment mapping)."""
+    X, y = _dense_problem(n=480)
+
+    def chunks():
+        return iter(
+            [(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)]
+        )
+
+    expected, _, _, _ = _sgd(max_iter=12).optimize_stream(
+        None, chunks(), BINARY_LOGISTIC_LOSS
+    )
+
+    ckpt = str(tmp_path / "stream_wf")
+    first = SGD(
+        max_iter=6, global_batch_size=96, tol=0.0,
+        checkpoint_dir=ckpt, checkpoint_key="swf", checkpoint_interval=6,
+    ).optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS)
+    assert first[3]["wholeFit"] is True
+    got = SGD(
+        max_iter=12, global_batch_size=96, tol=0.0,
+        checkpoint_dir=ckpt, checkpoint_key="swf", checkpoint_interval=12,
+    ).optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS)
+    assert got[2] == 12
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(expected))

@@ -34,6 +34,17 @@ from flink_ml_tpu.utils import metrics
 BUDGETS = {"disabled": 0, "tiny": 4_000, "unbounded": None}
 
 
+@pytest.fixture(autouse=True)
+def _per_epoch_input_pipeline():
+    """This battery probes the per-epoch replay pipeline (cache hit/miss
+    traffic, prefetch overlap, per-batch staging); the whole-fit resident
+    path bypasses it by design — stacked upload, zero cache lookups — so
+    the probes run against the chunked reference mode. Whole-fit's own
+    parity/traffic pins live in tests/test_dispatch_pipeline.py."""
+    with config.whole_fit_mode("off"):
+        yield
+
+
 @pytest.fixture
 def cache_budget():
     """Restore the process-wide budget/bucketing knobs after each test."""

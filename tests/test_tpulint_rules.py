@@ -1866,3 +1866,126 @@ class TestPrecisionDeterminism:
         }, ["precision-determinism"])
         assert report.findings == []
         assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# resident-program
+# ---------------------------------------------------------------------------
+
+class TestResidentProgram:
+    def test_true_positive_debug_print_in_jitted_impl(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/bad.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+                from ..utils.lazyjit import lazy_jit
+
+                def _train_impl(X, carry):
+                    def step(state):
+                        c, e = state
+                        jax.debug.print("epoch {e}", e=e)
+                        return c + jnp.sum(X), e + 1
+                    return lax.while_loop(lambda s: s[1] < 10, step, carry)
+
+                _train = lazy_jit(_train_impl)
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert len(report.findings) == 1
+        assert report.findings[0].rule == "resident-program"
+        assert "jax.debug.print" in report.findings[0].message
+
+    def test_true_positive_io_callback_in_loop_body(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/bad2.py": """
+                import jax.numpy as jnp
+                from jax import lax
+                from jax.experimental import io_callback
+
+                def fit(X):
+                    def body(state):
+                        io_callback(print, None, state)
+                        return state + 1
+                    return lax.while_loop(lambda s: s < 5, body, jnp.asarray(0))
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert len(report.findings) == 1
+        assert "io_callback" in report.findings[0].message
+
+    def test_true_positive_print_in_decorated_kernel(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/bad3.py": """
+                import jax
+                import jax.numpy as jnp
+
+                @jax.jit
+                def kernel(x):
+                    print("tracing side effect")
+                    return jnp.sum(x)
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert len(report.findings) == 1
+        assert "print" in report.findings[0].message
+
+    def test_true_negative_host_functions(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/good.py": """
+                import jax
+                import jax.numpy as jnp
+                from ..utils.lazyjit import lazy_jit
+
+                def _kernel_impl(x):
+                    return jnp.sum(x) * 2.0
+
+                _kernel = lazy_jit(_kernel_impl)
+
+                def host_driver(x):
+                    out = _kernel(x)
+                    print("fit done")  # host side: fine
+                    jax.debug.print("host-side debug {o}", o=out)
+                    return out
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert report.findings == []
+
+    def test_suppression_hides_and_unused_is_reported(self, tmp_path):
+        report = _run(tmp_path, {
+            "ops/supp.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+                from ..utils.lazyjit import lazy_jit
+
+                def _probe_impl(X, carry):
+                    def step(state):
+                        # tpulint: disable=resident-program -- diagnostic build, stripped before release
+                        jax.debug.print("state {s}", s=state)
+                        return state + jnp.sum(X)
+                    return lax.while_loop(lambda s: s < 3, step, carry)
+
+                _probe = lazy_jit(_probe_impl)
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+        stale = _run(tmp_path, {
+            "ops/stale.py": """
+                def host_only():
+                    # tpulint: disable=resident-program -- nothing resident here
+                    print("plain host print")
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert any(f.rule == "unused-suppression" for f in stale.findings)
