@@ -878,6 +878,117 @@ def bench_checkpoint_resume(n=200_000, d=64, max_iter=24, kill_after_chunks=8):
     return result
 
 
+def bench_multihost_checkpoint(
+    n=200_000, d=64, max_iter=12, host_counts=(1, 4, 8), kill_after=6
+):
+    """Multi-host snapshot workload (ISSUE 14): dense SGD checkpointing
+    every epoch through the sharded two-phase-commit coordinator
+    (ckpt/coordinator.py) at several simulated host counts. Reports per
+    host count: (a) save wall per epoch (wall delta vs the same fit
+    without checkpointing) and shard bytes per host — the scaling curve
+    of the per-host write path; (b) kill@manifest-commit -> resume wall
+    (the recovery number for a cut torn exactly at the two-phase-commit
+    window); (c) bit-identity — the killed+resumed sharded fit must land
+    on the single-file path's exact coefficients (asserted in-process:
+    the snapshot transport changes WHERE bytes live, never the model)."""
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu import config as _config
+    from flink_ml_tpu.ckpt import InjectedFault, faults
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(23)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.float32)
+
+    def fit(ckpt_dir=None, max_iter=max_iter):
+        sgd = SGD(
+            max_iter=max_iter, global_batch_size=20_000, tol=0.0,
+            checkpoint_dir=ckpt_dir, checkpoint_interval=1,
+            checkpoint_key="multiHostCheckpoint",
+        )
+        t0 = time.perf_counter()
+        coeff, _, epochs = sgd.optimize(
+            np.zeros(d, np.float32), X, y, None, BINARY_LOGISTIC_LOSS
+        )
+        return coeff, epochs, time.perf_counter() - t0
+
+    work = tempfile.mkdtemp(prefix="bench_mh_ckpt_")
+    per_hosts = {}
+    try:
+        fit()  # compile warmup
+        fit(os.path.join(work, "warm"))
+        _, _, plain_wall = fit()
+        expected, _, _ = fit(os.path.join(work, "single"))  # single-file ref
+
+        for hosts in host_counts:
+            with _config.snapshot_hosts_mode(hosts):
+                before = metrics.snapshot()
+                _, _, wall = fit(os.path.join(work, f"h{hosts}"))
+                delta = metrics.snapshot_delta(before, metrics.snapshot())[
+                    "counters"
+                ]
+            shard_bytes = int(delta.get("checkpoint.shard.bytes", 0))
+            shard_count = int(delta.get("checkpoint.shard.count", 0))
+            saves = int(delta.get("checkpoint.manifest.count", 0))
+            per_hosts[f"host{hosts}"] = {
+                "wallMs": wall * 1000.0,
+                "savePerEpochMs": (wall - plain_wall) * 1000.0 / max_iter,
+                "shardBytesPerHost": shard_bytes / max(1, saves * hosts),
+                "shardFilesPerSave": shard_count / max(1, saves),
+                "manifestCommits": saves,
+            }
+
+        # kill exactly inside the two-phase-commit window (shards landed,
+        # manifest rename never ran), then resume elastically onto a
+        # DIFFERENT simulated host count
+        kill_dir = os.path.join(work, "kill")
+        killed_at = None
+        with _config.snapshot_hosts_mode(host_counts[-1]):
+            try:
+                with faults.inject("snapshot.commit", after=kill_after):
+                    fit(kill_dir)
+            except InjectedFault as e:
+                killed_at = e.hits
+        assert killed_at is not None, "commit fault never fired"
+        with _config.snapshot_hosts_mode(host_counts[0]):
+            t0 = time.perf_counter()
+            resumed, epochs, _ = fit(kill_dir)
+            resume_wall = time.perf_counter() - t0
+        bit_identical = bool(
+            np.array_equal(np.asarray(resumed), np.asarray(expected))
+        )
+        assert bit_identical, (
+            "sharded kill@commit -> resume diverged from the single-file fit"
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    result = {
+        "numRows": n,
+        "dim": d,
+        "maxIter": max_iter,
+        "plainWallMs": plain_wall * 1000.0,
+        **per_hosts,
+        "killedAtCommit": killed_at,
+        "resumeWallMs": resume_wall * 1000.0,
+        "resumedEpochs": int(epochs),
+        "bitIdenticalToSingleFile": bit_identical,  # asserted above
+    }
+    biggest = per_hosts[f"host{host_counts[-1]}"]
+    log(
+        f"multiHostCheckpoint: {host_counts[-1]} hosts save "
+        f"{biggest['savePerEpochMs']:.2f}ms/epoch "
+        f"({biggest['shardBytesPerHost'] / 1e3:.1f}KB/host/save), "
+        f"kill@commit {killed_at} -> resume {resume_wall * 1000.0:.1f}ms, "
+        "bit-identical to the single-file path"
+    )
+    return result
+
+
 def bench_overload_soak(num_requests=60, batch_rows=256, d=24):
     """Robustness workload (ISSUE 8): bursty producer x slow/flaky
     consumer, asserted in-process:
@@ -1275,6 +1386,7 @@ def main(argv):
         "inputPipeline": None,
         "wholeFitDispatch": None,
         "checkpointResume": None,
+        "multiHostCheckpoint": None,
         "overloadSoak": None,
         "hotSwapSoak": None,
         "multichipCollectives": None,
@@ -1370,6 +1482,12 @@ def main(argv):
                 details["checkpointResume"] = bench_checkpoint_resume()
             except Exception as e:
                 log(f"checkpointResume stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["multiHostCheckpoint"] = bench_multihost_checkpoint()
+            except Exception as e:
+                log(f"multiHostCheckpoint stage failed: {e!r}")
 
         if in_budget():
             try:

@@ -15,5 +15,6 @@ from . import (  # noqa: F401
     residentprogram,
     retrace,
     shardingtags,
+    snapshotcommit,
     specconsistency,
 )

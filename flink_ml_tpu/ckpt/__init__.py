@@ -1,8 +1,10 @@
-"""Preemption-safe job checkpointing: the JobSnapshot format + the
-fault-injection harness that proves it (see `snapshot.py` / `faults.py`,
+"""Preemption-safe job checkpointing: the JobSnapshot format, the
+multi-host sharded-commit coordinator, and the fault-injection harness
+that proves them (see `snapshot.py` / `coordinator.py` / `faults.py`,
 and docs/fault_tolerance.md for the contracts)."""
 
-from .faults import FaultPlan, InjectedFault, failing_map, inject, tick
+from .coordinator import SnapshotAborted, SnapshotIntegrityError
+from .faults import FaultPlan, InjectedFault, failing_map, flaky, inject, tick
 from .snapshot import (
     SNAPSHOT_VERSION,
     JobSnapshot,
@@ -19,9 +21,12 @@ __all__ = [
     "save_job_snapshot",
     "snapshot_file",
     "stage_section",
+    "SnapshotAborted",
+    "SnapshotIntegrityError",
     "FaultPlan",
     "InjectedFault",
     "failing_map",
+    "flaky",
     "inject",
     "tick",
 ]

@@ -30,6 +30,18 @@ out of the loop at a controlled point. Two entry styles:
   |                   | the torn-write case the atomicity contract covers |
   | `snapshot.read`   | INSIDE `load_job_snapshot`, before the npz is     |
   |                   | opened — the transient-restore-I/O case           |
+  | `snapshot.shard.  | INSIDE one host's shard write on the sharded      |
+  |  write`           | path (coordinator.py), after its temp file but    |
+  |                   | BEFORE its atomic rename — ticks once PER HOST,   |
+  |                   | so `inject(after=k)` kills host k mid-shard-write |
+  | `snapshot.commit` | INSIDE the coordinator's manifest commit, after   |
+  |                   | every shard landed but BEFORE the manifest        |
+  |                   | rename — the torn two-phase-commit case (shards   |
+  |                   | on disk, cut never committed)                     |
+  | `snapshot.        | INSIDE each manifest read on the sharded restore  |
+  |  manifest.read`   | path — transient-I/O twin of `snapshot.read`      |
+  | `snapshot.shard.  | INSIDE each shard-file read (restore validation   |
+  |  read`            | and post-write digesting) — ticks once per file   |
   | `datacache.read`  | INSIDE `DataCache.read_array` — a spill-file read |
   | `datacache.append`| INSIDE `DataCache.append_array` — a spill write   |
   | `serving.batch`   | INSIDE `MicroBatchServer`'s batch dispatch        |

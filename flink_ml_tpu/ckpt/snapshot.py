@@ -130,6 +130,53 @@ def _normalize_specs(
     return specs
 
 
+def _gather_sections(
+    sections: Dict[str, Any],
+    specs: Dict[str, Union[str, Sequence[str]]],
+):
+    """Flatten every section to host arrays — device leaves across ALL
+    sections gathered in ONE packed D2H transfer (a per-leaf pull pays
+    one tunnel round trip per leaf) — and build the manifest inventory
+    (key/spec/dtype/shape per leaf, plus a crc32 content digest of each
+    leaf's bytes, verified on restore)."""
+    import zlib
+
+    import jax
+
+    from ..utils.packing import packed_device_get
+
+    arrays: Dict[str, np.ndarray] = {}
+    manifest_sections: Dict[str, Any] = {}
+    gather: list = []  # device leaves, gathered in one packed transfer
+    gather_slots: list = []  # (section array key) aligned with `gather`
+    for name, tree in sections.items():
+        leaves, _ = _tree_flatten(tree)
+        tags = _normalize_specs(specs.get(name), len(leaves), name)
+        entries = []
+        for i, leaf in enumerate(leaves):
+            key = f"s_{name}_{i}"
+            if isinstance(leaf, jax.Array):
+                gather.append(leaf)
+                gather_slots.append(key)
+            else:
+                arrays[key] = np.asarray(leaf)
+            entries.append({"key": key, "spec": tags[i]})
+        manifest_sections[name] = {"leaves": entries}
+    if gather:
+        host = packed_device_get(*gather, sync_kind="checkpoint")
+        for key, arr in zip(gather_slots, host):
+            arrays[key] = np.asarray(arr)
+    for name, section in manifest_sections.items():
+        for entry in section["leaves"]:
+            arr = arrays[entry["key"]]
+            entry["dtype"] = str(arr.dtype)
+            entry["shape"] = list(arr.shape)
+            entry["crc32"] = (
+                zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+            )
+    return arrays, manifest_sections
+
+
 def save_job_snapshot(
     path: str,
     job_key: Optional[str],
@@ -139,50 +186,72 @@ def save_job_snapshot(
     criteria: float = 0.0,
     specs: Optional[Dict[str, Union[str, Sequence[str]]]] = None,
     meta: Optional[Dict[str, Any]] = None,
-) -> str:
-    """Write a versioned snapshot atomically; returns the target path.
+    hosts: Optional[int] = None,
+    stable_sections: Optional[Dict[str, Any]] = None,
+) -> Optional[str]:
+    """Write a versioned snapshot atomically; returns the target path
+    (the npz, or the committed manifest on the sharded path), or None
+    when a sharded cut was ABORTED by a straggler host (the previous
+    committed snapshot stays restorable; training may continue).
 
-    Device leaves across ALL sections are gathered in one packed D2H
-    transfer (a per-leaf pull pays one tunnel round trip per leaf). The
-    write order is temp-file-then-`os.replace`: the commit point is the
-    rename, so a kill at any earlier instant (the `snapshot.write` fault
-    site sits right before the rename) leaves the previous snapshot
-    intact and restorable."""
-    import jax
+    Single-host (the default): ONE npz, temp-file-then-`os.replace` —
+    the commit point is the rename, so a kill at any earlier instant
+    (the `snapshot.write` fault site sits right before the rename)
+    leaves the previous snapshot intact and restorable. Per-leaf crc32
+    digests ride the manifest and are verified on restore.
 
+    Multi-host (`hosts` argument > `config.snapshot_hosts`): the
+    two-phase sharded protocol of `ckpt/coordinator.py` — each simulated
+    host writes only its own per-leaf slices, the coordinator commits an
+    atomic digest-carrying manifest, retention GC runs on commit.
+    `stable_sections` maps section names to zero-arg providers of
+    immutable host-leaf tuples (the stream-cache contents), written once
+    per job key and reused by reference across cuts; ignored on the
+    single-file path."""
+    from .. import config
     from ..obs import tracing
-    from ..utils.packing import packed_device_get
+    from . import coordinator
 
     specs = specs or {}
+    n_hosts = hosts if hosts is not None else config.snapshot_hosts
     with tracing.span(
         "checkpoint.save", jobKey=job_key or "", epoch=int(epoch)
     ) as sp:
-        arrays: Dict[str, np.ndarray] = {}
-        manifest_sections: Dict[str, Any] = {}
-        gather: list = []  # device leaves, gathered in one packed transfer
-        gather_slots: list = []  # (section array key) aligned with `gather`
-        for name, tree in sections.items():
-            leaves, _ = _tree_flatten(tree)
-            tags = _normalize_specs(specs.get(name), len(leaves), name)
-            entries = []
-            for i, leaf in enumerate(leaves):
-                key = f"s_{name}_{i}"
-                if isinstance(leaf, jax.Array):
-                    gather.append(leaf)
-                    gather_slots.append(key)
-                else:
-                    arrays[key] = np.asarray(leaf)
-                entries.append({"key": key, "spec": tags[i]})
-            manifest_sections[name] = {"leaves": entries}
-        if gather:
-            host = packed_device_get(*gather, sync_kind="checkpoint")
-            for key, arr in zip(gather_slots, host):
-                arrays[key] = np.asarray(arr)
-        for name, section in manifest_sections.items():
-            for entry in section["leaves"]:
-                arr = arrays[entry["key"]]
-                entry["dtype"] = str(arr.dtype)
-                entry["shape"] = list(arr.shape)
+        arrays, manifest_sections = _gather_sections(sections, specs)
+        nbytes = sum(a.nbytes for a in arrays.values())
+
+        if n_hosts is not None:
+            sp.set_attr("hosts", int(n_hosts))
+            stable_specs = {
+                name: tag
+                for name, tag in specs.items()
+                if isinstance(tag, str) and name in (stable_sections or {})
+            }
+            try:
+                target = coordinator.save_sharded(
+                    path,
+                    job_key,
+                    arrays,
+                    manifest_sections,
+                    epoch=epoch,
+                    criteria=criteria,
+                    meta=meta,
+                    hosts=int(n_hosts),
+                    stable_sections=stable_sections,
+                    stable_specs=stable_specs,
+                    snapshot_version=SNAPSHOT_VERSION,
+                )
+            except coordinator.SnapshotAborted as e:
+                # abort-this-cut: the job keeps training; the previous
+                # committed cut stays restorable and the next boundary
+                # tries again
+                warnings.warn(f"snapshot cut aborted (epoch {epoch}): {e}")
+                sp.set_attr("aborted", True)
+                return None
+            metrics.inc_counter("checkpoint.count")
+            metrics.inc_counter("checkpoint.bytes", nbytes)
+            sp.set_attr("bytes", nbytes)
+            return target
 
         manifest = {
             "version": SNAPSHOT_VERSION,
@@ -194,26 +263,47 @@ def save_job_snapshot(
         }
         os.makedirs(path, exist_ok=True)
         target = snapshot_file(path, job_key)
-        tmp = target[: -len(".npz")] + ".tmp.npz"  # keep .npz so savez won't rename
-
-        def commit() -> None:
-            np.savez(tmp, manifest=np.asarray(json.dumps(manifest)), **arrays)
-            # torn-write injection point: a kill here models a crash after
-            # the temp payload hit disk but before the atomic commit below
-            faults.tick("snapshot.write")
-            os.replace(tmp, target)
 
         # transient write faults (flaky filesystem, faults.flaky plans)
         # re-run the WHOLE temp-write-then-rename sequence — safe because
         # nothing before the os.replace is observable to a reader; a fatal
         # InjectedFault is not transient and still kills the job mid-write
-        flow.with_retries(commit, site="snapshot.write")
+        coordinator.atomic_commit(
+            target,
+            lambda tmp: np.savez(
+                tmp, manifest=np.asarray(json.dumps(manifest)), **arrays
+            ),
+            site="snapshot.write",
+        )
 
-        nbytes = sum(a.nbytes for a in arrays.values())
         metrics.inc_counter("checkpoint.count")
         metrics.inc_counter("checkpoint.bytes", nbytes)
         sp.set_attr("bytes", nbytes)
     return target
+
+
+def _verify_leaf_digest(file: str, section: str, entry, arr) -> None:
+    """Check a stored leaf's bytes against its manifest crc32 (absent in
+    pre-digest snapshots: nothing to verify). A mismatch is bit rot on
+    the ONLY copy — it fails loudly naming the leaf, is NOT a
+    `flow.TransientError` (re-reading the same corrupt bytes cannot
+    help, so the surrounding retry wrapper must not spin on it), and is
+    deliberately not a refuse-and-return-None: silently training from
+    scratch over a corrupt checkpoint hides the corruption."""
+    if "crc32" not in entry:
+        return
+    import zlib
+
+    from .coordinator import SnapshotIntegrityError
+
+    got = zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+    if got != entry["crc32"]:
+        metrics.inc_counter("checkpoint.digest.mismatch")
+        raise SnapshotIntegrityError(
+            f"snapshot {file}: leaf {entry['key']!r} (section {section!r}) "
+            f"is corrupt — stored crc32 {entry['crc32']}, actual {got}. "
+            "The snapshot cannot be trusted; restore refused."
+        )
 
 
 def _leaf_mismatch(template_leaves, entries) -> Optional[str]:
@@ -245,10 +335,32 @@ def load_job_snapshot(
 
     Falls back to the legacy carry-only `ckpt-*.npz` format (one-way
     migration) when no snapshot file exists and a `model` template is
-    given. Un-keyed restores warn: see `_UNKEYED_WARNING`."""
+    given. Un-keyed restores warn: see `_UNKEYED_WARNING`.
+
+    When the directory holds committed SHARDED cuts for this key
+    (ckpt/coordinator.py), they are authoritative: restore goes through
+    the coordinator — per-shard digest validation, refusal of
+    partial/torn commits, fallback to the last committed cut — and does
+    NOT fall through to a stale single-file/legacy snapshot."""
     import jax
 
     from ..obs import tracing
+    from . import coordinator
+
+    if coordinator.has_sharded(path, job_key):
+        with tracing.span(
+            "checkpoint.restore", jobKey=job_key or "", sharded=True
+        ) as sp:
+            snap = coordinator.load_sharded(
+                path, job_key, templates, expect_meta=expect_meta
+            )
+            if snap is None:
+                return None
+            if job_key is None:
+                warnings.warn(_UNKEYED_WARNING)
+            metrics.inc_counter("checkpoint.restore.count")
+            sp.set_attr("epoch", int(snap.epoch))
+            return snap
 
     file = snapshot_file(path, job_key)
     if not os.path.exists(file):
@@ -286,6 +398,8 @@ def load_job_snapshot(
                 for name, section in manifest["sections"].items():
                     entries = section["leaves"]
                     specs[name] = tuple(e.get("spec", "replicated") for e in entries)
+                    for e in entries:
+                        _verify_leaf_digest(file, name, e, f[e["key"]])
                     template = (templates or {}).get(name)
                     if template is None:
                         sections[name] = [np.asarray(f[e["key"]]) for e in entries]
@@ -348,6 +462,12 @@ def _load_legacy(
     file = _checkpoint_file(path, job_key)
     if not os.path.exists(file):
         return None
+    warnings.warn(
+        f"legacy checkpoint {file}: the pre-JobSnapshot carry-only format "
+        "records no integrity digests, so this restore CANNOT be verified "
+        "against bit rot; the first save after resume migrates to the "
+        "digest-carrying snapshot format"
+    )
     with np.load(file) as f:
         leaves, treedef = _tree_flatten(template)
         if any(f"leaf_{i}" not in f for i in range(len(leaves))) or (

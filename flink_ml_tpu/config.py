@@ -316,6 +316,75 @@ if os.environ.get("FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY") in (
     online_overload_policy = os.environ["FLINK_ML_TPU_ONLINE_OVERLOAD_POLICY"]
 
 
+# --- multi-host snapshot coordination (ckpt/coordinator.py) -------------------
+# Simulated host count for the sharded JobSnapshot path: with N >= 1, each
+# (simulated) host writes ONLY its own per-leaf slices as
+# `snap-<key>.c<cut>.host<i>.npz` and a coordinator commits an atomic
+# manifest recording per-shard content digests, the leaf->shard layout and
+# the host count — the DCN-ready write path ROADMAP item 1 needs, chaos-
+# tested on the virtual-device substrate (hosts are contiguous mesh device
+# groups, parallel/mesh.host_groups). None = the single-file snapshot path.
+# Restore reads EITHER format regardless of this knob (a sharded manifest
+# wins when both exist), and re-stitches N-host shards onto an M-host mesh
+# through `stage_section` — elastic in both directions.
+snapshot_hosts: Optional[int] = None
+# Committed snapshot cuts retained per job key (manifest + shard files):
+# commit-time GC keeps the last N, so rollback-to-previous-cut is always
+# possible (the restore fallback when the newest cut is torn or bit-rotten)
+# and disk use stays bounded. Must be >= 1; >= 2 to actually have a
+# fallback target.
+snapshot_retained: int = 2
+# Straggler deadline for one host's shard write (seconds, wall time
+# including retry backoff): a host that cannot land its shard within the
+# deadline ABORTS THE CUT — the cut's partial files are deleted, the
+# previous committed snapshot stays restorable, and training continues to
+# the next boundary (`checkpoint.abort`). None = no deadline (retries
+# bound the wait via config.transient_retries alone).
+snapshot_host_deadline_s: Optional[float] = None
+# Include the stream-training cache CONTENTS (the packed [X|y|w] segments
+# of SGD.optimize_stream) as a per-host-sharded `cache` section in sharded
+# snapshots, written ONCE per job key (immutable for the fit, reused by
+# reference across cuts): a resumed stream fit rebuilds its segments from
+# the snapshot and never re-consumes the input stream.
+snapshot_cache_contents: bool = True
+
+
+@contextmanager
+def snapshot_hosts_mode(hosts: Optional[int]):
+    """Scoped override of `snapshot_hosts` (None = single-file path)."""
+    global snapshot_hosts
+    if hosts is not None and int(hosts) < 1:
+        raise ValueError(f"snapshot_hosts must be >= 1, got {hosts!r}")
+    prev = snapshot_hosts
+    snapshot_hosts = None if hosts is None else int(hosts)
+    try:
+        yield
+    finally:
+        snapshot_hosts = prev
+
+
+@contextmanager
+def snapshot_retention_mode(retained: int):
+    """Scoped override of `snapshot_retained` (>= 1)."""
+    global snapshot_retained
+    prev = snapshot_retained
+    snapshot_retained = max(1, int(retained))
+    try:
+        yield
+    finally:
+        snapshot_retained = prev
+
+
+if os.environ.get("FLINK_ML_TPU_SNAPSHOT_HOSTS"):
+    snapshot_hosts = max(1, int(os.environ["FLINK_ML_TPU_SNAPSHOT_HOSTS"]))
+if os.environ.get("FLINK_ML_TPU_SNAPSHOT_RETAINED"):
+    snapshot_retained = max(1, int(os.environ["FLINK_ML_TPU_SNAPSHOT_RETAINED"]))
+if os.environ.get("FLINK_ML_TPU_SNAPSHOT_HOST_DEADLINE_S"):
+    snapshot_host_deadline_s = float(
+        os.environ["FLINK_ML_TPU_SNAPSHOT_HOST_DEADLINE_S"]
+    )
+
+
 # --- model lifecycle: hot-swap, promotion gate, rollback (lifecycle.py) -------
 # Promoted model versions retained in the lifecycle ring (host copies):
 # rollback targets live here, so a bad promotion can be rolled back to the

@@ -50,7 +50,13 @@ from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, Optional
 from .. import flow
 from ..utils import metrics
 
-__all__ = ["DeviceEpochCache", "CachedEpochLoader", "within_device_budget"]
+__all__ = [
+    "DeviceEpochCache",
+    "CachedEpochLoader",
+    "within_device_budget",
+    "cache_contents_section",
+    "restore_cache_contents",
+]
 
 _UNSET = object()
 
@@ -74,6 +80,47 @@ def _tree_nbytes(tree) -> int:
     return sum(
         int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree_util.tree_leaves(tree)
     )
+
+
+# ---------------------------------------------------------------------------
+# cache-contents snapshot sections (multi-host sharded snapshots)
+# ---------------------------------------------------------------------------
+# The ROADMAP item-5 follow-up of PR 6: snapshot the epoch cache's
+# CONTENTS, not just its cursor. A stream fit's packed segments — the host
+# tier the DeviceEpochCache stages from — travel in the sharded JobSnapshot
+# as a `cache` section (spec tag `data`: each simulated host writes its own
+# row slice of every segment, ckpt/coordinator.py), written ONCE per job
+# key as a *stable* section and reused by reference across snapshot cuts.
+# A resumed fit rebuilds its segments from the snapshot and never
+# re-consumes the input stream (`restore_cache_contents`).
+
+def cache_contents_section(cache, segs):
+    """Materialize the stream cache's packed segments as the host-array
+    tuple a snapshot `cache` section stores. Called ONCE, at fit start,
+    BEFORE the epoch loader's pump worker exists — the native cache's
+    serial-access constraint means snapshot saves inside the training
+    loop must never touch it, so the section is captured eagerly and the
+    saves close over these arrays (in-memory segments alias the cache's
+    own storage; only spilled segments pay a copy)."""
+    return tuple(cache.read_array(seg) for seg in segs)
+
+
+def restore_cache_contents(snap, cache):
+    """Rebuild a fresh host cache from a snapshot's `cache` section:
+    append every stored segment (replay order) and return the new
+    segment ids, or None when the snapshot carries no cache contents —
+    the caller then re-ingests from the input stream as before."""
+    import numpy as np
+
+    section = snap.sections.get("cache")
+    if section is None:
+        return None
+    segs = [
+        cache.append_array(np.ascontiguousarray(np.asarray(arr)))
+        for arr in section
+    ]
+    metrics.inc_counter("devicecache.contents.restored", len(segs))
+    return segs
 
 
 class DeviceEpochCache:

@@ -488,7 +488,13 @@ class KMeans(Estimator, KMeansParams):
         # seed, but the generator's post-init state is job state and
         # travels with the job). Keyed by the stage's param-hash job key;
         # `numBatches` in meta refuses a snapshot from a different stream
-        # layout (the epoch→batch replay mapping would diverge).
+        # layout (the epoch→batch replay mapping would diverge). Under
+        # `config.snapshot_hosts` both save and restore ride the sharded
+        # two-phase-commit coordinator (ckpt/coordinator.py): replicated
+        # centroid/count leaves and the host RNG land on host 0's shard,
+        # the manifest commit is the cut, and the restore below accepts
+        # either format (kill-mid-commit chaos case pinned in
+        # tests/test_fault_injection.py).
         from ...ckpt import faults
         from ...ckpt import snapshot as _snapshot
         from ...parallel.iteration import checkpoint_job_key

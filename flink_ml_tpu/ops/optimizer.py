@@ -725,35 +725,58 @@ class SGD:
             packed = np.concatenate([Xb, yb[:, None], wb[:, None]], axis=1)
             segs.append(cache.append_array(np.ascontiguousarray(packed)))
 
-        for chunk in chunks:
-            X, y, w = chunk
-            X = np.asarray(X, self.dtype)
-            y = np.asarray(y, self.dtype)
-            w = (
-                np.ones(X.shape[0], self.dtype)
-                if w is None
-                else np.asarray(w, self.dtype)
+        # Resume WITHOUT re-ingest (docs/fault_tolerance.md "Multi-host
+        # snapshots"): a sharded snapshot carries the stream cache's
+        # CONTENTS as a stable `cache` section — the packed segments are
+        # rebuilt straight from the snapshot shards and the input stream
+        # is never consumed (the epoch cache's data source survives the
+        # preemption, not just its cursor).
+        restored_segs = None
+        if self.checkpoint_dir is not None and config.snapshot_cache_contents:
+            from ..ckpt import snapshot as _snapshot
+            from ..data.devicecache import restore_cache_contents
+
+            peek = _snapshot.load_job_snapshot(
+                self.checkpoint_dir,
+                self.checkpoint_key,
+                expect_meta={"globalBatchSize": int(self.global_batch_size)},
             )
-            d = X.shape[1] if d is None else d
+            if peek is not None and "dim" in peek.meta:
+                restored_segs = restore_cache_contents(peek, cache)
+                if restored_segs is not None:
+                    d = int(peek.meta["dim"])
+        if restored_segs is not None:
+            segs = restored_segs
+        else:
+            for chunk in chunks:
+                X, y, w = chunk
+                X = np.asarray(X, self.dtype)
+                y = np.asarray(y, self.dtype)
+                w = (
+                    np.ones(X.shape[0], self.dtype)
+                    if w is None
+                    else np.asarray(w, self.dtype)
+                )
+                d = X.shape[1] if d is None else d
+                if pend is not None:
+                    X = np.concatenate([pend[0], X])
+                    y = np.concatenate([pend[1], y])
+                    w = np.concatenate([pend[2], w])
+                    pend = None
+                off = 0
+                while X.shape[0] - off >= B:
+                    emit(X[off : off + B], y[off : off + B], w[off : off + B])
+                    off += B
+                if off < X.shape[0]:
+                    pend = (X[off:], y[off:], w[off:])
             if pend is not None:
-                X = np.concatenate([pend[0], X])
-                y = np.concatenate([pend[1], y])
-                w = np.concatenate([pend[2], w])
-                pend = None
-            off = 0
-            while X.shape[0] - off >= B:
-                emit(X[off : off + B], y[off : off + B], w[off : off + B])
-                off += B
-            if off < X.shape[0]:
-                pend = (X[off:], y[off:], w[off:])
-        if pend is not None:
-            Xr, yr, wr = pend
-            extra = B - Xr.shape[0]
-            emit(
-                np.pad(Xr, [(0, extra), (0, 0)]),
-                np.pad(yr, (0, extra)),
-                np.pad(wr, (0, extra)),
-            )
+                Xr, yr, wr = pend
+                extra = B - Xr.shape[0]
+                emit(
+                    np.pad(Xr, [(0, extra), (0, 0)]),
+                    np.pad(yr, (0, extra)),
+                    np.pad(wr, (0, extra)),
+                )
         if not segs:
             raise ValueError("optimize_stream received an empty stream")
         if init_coeff is None:
@@ -772,10 +795,31 @@ class SGD:
         epoch, criteria = 0, float("inf")
         # segment count + batch size pin the epoch→segment mapping; a
         # snapshot written against a different stream layout is refused
+        # (`dim` rides along so a cache-contents resume can rebuild its
+        # carry templates before touching any data)
         ckpt_meta = {
             "numSegments": nb,
             "globalBatchSize": int(self.global_batch_size),
+            "dim": int(d),
         }
+        # Cache CONTENTS as a stable snapshot section (sharded path only):
+        # captured eagerly, BEFORE the epoch loader's pump worker exists —
+        # the native cache is serial-access, so saves inside the training
+        # loop must close over these arrays instead of re-reading it. The
+        # coordinator writes the section ONCE per job key and reuses it by
+        # reference across cuts.
+        stable_sections = None
+        stable_specs = {}
+        if (
+            self.checkpoint_dir is not None
+            and config.snapshot_hosts is not None
+            and config.snapshot_cache_contents
+        ):
+            from ..data.devicecache import cache_contents_section
+
+            contents = cache_contents_section(cache, segs)
+            stable_sections = {"cache": lambda: contents}
+            stable_specs = {"cache": "data"}
         if self.checkpoint_dir is not None:
             from ..ckpt import snapshot as _snapshot
 
@@ -838,6 +882,7 @@ class SGD:
                 return self._stream_whole_fit(
                     cache, segs, carry, epoch, criteria, loss_func, hyper,
                     mesh, d, b_pad, interval, ckpt_meta,
+                    stable_sections, stable_specs,
                 )
             finally:
                 cache.close()
@@ -867,9 +912,11 @@ class SGD:
                         {"model": entry.carry},
                         epoch=e_act,
                         criteria=crit,
+                        specs=stable_specs or None,
                         # the device-epoch-cache key cursor: the segment
                         # the next epoch after this snapshot replays
                         meta={**ckpt_meta, "cacheCursor": e_act % nb},
+                        stable_sections=stable_sections,
                     )
                 if crit <= self.tol:
                     stopped = True
@@ -928,6 +975,7 @@ class SGD:
     def _stream_whole_fit(
         self, cache, segs, carry, start_epoch, criteria, loss_func, hyper,
         mesh, d, b_pad, interval, ckpt_meta,
+        stable_sections=None, stable_specs=None,
     ):
         """Whole-fit arm of `optimize_stream` (see the call site): one
         stacked upload, one resident program (`_sgd_stream_whole_fit`),
@@ -973,7 +1021,9 @@ class SGD:
                     {"model": carry},
                     epoch=final_epoch,
                     criteria=final_crit,
+                    specs=stable_specs or None,
                     meta={**ckpt_meta, "cacheCursor": final_epoch % nb},
+                    stable_sections=stable_sections,
                 )
             faults.tick("epoch")  # one drained readback = one tick
         stats = {

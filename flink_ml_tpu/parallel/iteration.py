@@ -456,12 +456,16 @@ def iterate_unbounded(
         yield version, state
     if checkpoint_dir is not None:
         # the stream completed: clear the checkpoint so a NEW job reusing
-        # this dir does not resume from (and skip past) a finished run
+        # this dir does not resume from (and skip past) a finished run —
+        # sharded cuts (manifests + shards) included
+        from ..ckpt import coordinator as _coordinator
+
         for file in (
             _snapshot.snapshot_file(checkpoint_dir, job_key),
             _checkpoint_file(checkpoint_dir, job_key),
         ):
             if os.path.exists(file):
                 os.remove(file)
+        _coordinator.purge(checkpoint_dir, job_key)
     if listener is not None:
         listener.on_iteration_terminated(state)

@@ -123,6 +123,60 @@ def replicate(mesh: Mesh, array) -> jax.Array:
     return jax.device_put(np.asarray(array), replicated_sharding(mesh))
 
 
+# ---------------------------------------------------------------------------
+# host-group mapping (multi-host snapshot coordination, ckpt/coordinator.py)
+# ---------------------------------------------------------------------------
+# On real DCN hardware `jax.devices()` spans processes and each host owns a
+# contiguous slab of the device order (jax's device order follows the ICI
+# topology, and process boundaries align with it). The virtual-device
+# substrate models the same shape: a "host" is a contiguous group of mesh
+# devices, and a leaf's per-host shard is the slice of the FULL array that
+# host's devices would hold under the leaf's sharding tag. The tag->axis
+# mapping lives here, next to the `<tag>_sharding` constructors it mirrors:
+# `data` shards the leading (batch) dim, `model` the trailing (feature)
+# dim, `replicated`/`host` leaves are whole-array and owned by host 0.
+
+def host_groups(mesh: Mesh, num_hosts: int):
+    """The mesh's devices as `num_hosts` contiguous groups (host i owns
+    group i). Host counts need not divide the device count — trailing
+    groups may be one device short (np.array_split semantics), and a host
+    count above the device count leaves the surplus hosts empty-handed
+    for devices but still shard OWNERS for snapshot writes."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    devices = list(mesh.devices.flat)
+    return [list(g) for g in np.array_split(np.array(devices), num_hosts)]
+
+
+def shard_axis_for_tag(tag: str, ndim: int) -> Optional[int]:
+    """The array axis a sharding-spec tag splits across hosts, or None for
+    whole-array tags (`replicated` / `host`). Mirrors `data_sharding`
+    (leading dim) and `model_sharding` (trailing dim)."""
+    if ndim <= 0:
+        return None
+    if tag == "data":
+        return 0
+    if tag == "model":
+        return ndim - 1
+    return None
+
+
+def host_slice_bounds(length: int, num_hosts: int):
+    """Per-host [start, stop) bounds splitting `length` rows/cols across
+    `num_hosts` (np.array_split semantics: uneven lengths allowed, empty
+    trailing slices when hosts outnumber elements)."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    base, extra = divmod(int(length), int(num_hosts))
+    bounds = []
+    start = 0
+    for h in range(num_hosts):
+        stop = start + base + (1 if h < extra else 0)
+        bounds.append((start, stop))
+        start = stop
+    return bounds
+
+
 def init_distributed(coordinator_address: Optional[str] = None, **kwargs) -> None:
     """Multi-host bring-up over DCN (the analogue of the reference's cluster
     deployment). No-op when running single-process."""

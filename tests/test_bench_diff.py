@@ -97,6 +97,45 @@ def test_whole_fit_dispatch_regressions_fail_gate():
     assert strict[0]["verdict"] == "REGRESSED"
 
 
+def test_multihost_checkpoint_gating_directions():
+    """multiHostCheckpoint (ISSUE 14): the per-host-count save walls and
+    the kill@commit resume wall are direction-gated (lower); shard sizing
+    is informational (bytes-per-host is a layout fact, not a speed)."""
+    assert (
+        bench_diff.metric_direction("multiHostCheckpoint.host4.savePerEpochMs")
+        == "lower"
+    )
+    assert (
+        bench_diff.metric_direction("multiHostCheckpoint.resumeWallMs")
+        == "lower"
+    )
+    assert (
+        bench_diff.metric_direction("multiHostCheckpoint.host4.shardBytesPerHost")
+        is None
+    )
+    old = {
+        "multiHostCheckpoint": bench_diff.flatten(
+            {
+                "host4": {"savePerEpochMs": 20.0, "shardBytesPerHost": 300.0},
+                "resumeWallMs": 100.0,
+            }
+        )
+    }
+    new = {
+        "multiHostCheckpoint": bench_diff.flatten(
+            {
+                "host4": {"savePerEpochMs": 30.0, "shardBytesPerHost": 600.0},
+                "resumeWallMs": 150.0,
+            }
+        )
+    }
+    rows = bench_diff.diff_entries(old, new, 0.15, [])
+    verdicts = {r["path"]: r["verdict"] for r in rows}
+    assert verdicts["multiHostCheckpoint.host4.savePerEpochMs"] == "REGRESSED"
+    assert verdicts["multiHostCheckpoint.resumeWallMs"] == "REGRESSED"
+    assert verdicts["multiHostCheckpoint.host4.shardBytesPerHost"] == "info"
+
+
 def test_cold_time_informational_by_default():
     rows = bench_diff.diff_entries(
         {"e": {"coldTimeMs": 100.0}}, {"e": {"coldTimeMs": 200.0}}, 0.15, []

@@ -15,6 +15,8 @@ bit-identical to a PLANNED rescale at the same epoch boundary — i.e. the
 snapshot transports the job state across meshes losslessly, and the
 fault changes nothing the planned handoff would not."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -566,3 +568,280 @@ def test_whole_fit_stream_end_snapshot_resume(tmp_path):
     ).optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS)
     assert got[2] == 12
     np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(expected))
+
+
+# ---------------------------------------------------------------------------
+# multi-host sharded snapshots: the host-failure chaos matrix
+# (ckpt/coordinator.py — per-host shard writes + two-phase commit manifest;
+# hosts are simulated mesh groups, config.snapshot_hosts)
+# ---------------------------------------------------------------------------
+
+def _dense_ref(tmp_path):
+    X, y = _dense_problem()
+    ref = str(tmp_path / "ref")
+    expected, _, _ = _sgd(ref).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+    return X, y, expected
+
+
+def test_mh_dense_kill_mid_shard_write_resume_bit_identical(tmp_path):
+    """Host 2 dies mid-shard-write (temp written, rename never ran): the
+    cut is torn, the job crashes, and the resumed run restores the last
+    COMMITTED cut and lands on the uninterrupted run's exact model."""
+    X, y, expected = _dense_ref(tmp_path)
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.shard.write", after=4 * 4 + 3) as plan:
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+        assert plan.fired  # died on cut 5's host-2 write
+        got, _, epochs = _sgd(ckpt).optimize(
+            np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+        )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_dense_kill_mid_manifest_commit_resume_bit_identical(tmp_path):
+    """The two-phase-commit torn window: every shard of the cut landed,
+    the manifest rename never ran — restore must treat the cut as never
+    having happened."""
+    from flink_ml_tpu.ckpt import coordinator
+
+    X, y, expected = _dense_ref(tmp_path)
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.commit", after=5) as plan:
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+        assert plan.fired
+        # the torn cut left shards but no manifest
+        assert 5 not in coordinator.committed_cuts(ckpt, "fault")
+        got, _, epochs = _sgd(ckpt).optimize(
+            np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+        )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_dense_straggler_abort_then_kill_resume_bit_identical(tmp_path):
+    """A straggler host aborts ONE cut (training continues, warned); a
+    later kill resumes from the last cut that DID commit — the aborted
+    boundary is simply re-covered by recomputation."""
+    X, y, expected = _dense_ref(tmp_path)
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4), config.transient_retry_mode(2):
+        # 3 transient failures = 1 attempt + 2 retries: exactly one save
+        # (cut 3) exhausts its budget and aborts; later saves are healthy
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with faults.flaky("snapshot.shard.write", times=3):
+                with faults.inject("chunk", after=4):
+                    with pytest.raises(InjectedFault):
+                        _sgd(ckpt).optimize(
+                            np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+                        )
+        assert any("aborted" in str(w.message) for w in caught)
+        got, _, epochs = _sgd(ckpt).optimize(
+            np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+        )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_dense_digest_mismatch_falls_back_resume_bit_identical(tmp_path):
+    """Bit rot on the newest committed cut: restore refuses it (digest
+    mismatch), falls back to the previous cut, and the resume still lands
+    on the uninterrupted model — more recomputation, zero corruption."""
+    from flink_ml_tpu.ckpt import coordinator
+
+    X, y, expected = _dense_ref(tmp_path)
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("chunk", after=7):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+        newest = coordinator.committed_cuts(ckpt, "fault")[-1]
+        with open(coordinator.shard_file(ckpt, "fault", newest, 0), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.warns(UserWarning, match="mismatch"):
+            got, _, epochs = _sgd(ckpt).optimize(
+                np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+            )
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_dense_flaky_reads_on_resume_bit_identical(tmp_path):
+    """Transient manifest/shard read faults during the restore retry
+    through the budget and the resume is indistinguishable from a clean
+    one."""
+    X, y, expected = _dense_ref(tmp_path)
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("chunk", after=6):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+        with config.transient_retry_mode(3):
+            with faults.flaky("snapshot.shard.read", times=2) as plan:
+                got, _, epochs = _sgd(ckpt).optimize(
+                    np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS
+                )
+    assert plan.failures == 2
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_sparse_sgd_kill_mid_commit_resume_bit_identical(tmp_path):
+    rng = np.random.RandomState(1)
+    n, d, nnz = 384, 24, 4
+    indices = np.full((n, nnz), -1, np.int32)
+    values = np.zeros((n, nnz), np.float32)
+    for i in range(n):
+        cols = np.sort(rng.choice(d, size=nnz, replace=False))
+        indices[i] = cols
+        values[i] = rng.rand(nnz)
+    dense = np.zeros((n, d), np.float32)
+    np.put_along_axis(dense, indices, values, axis=1)
+    y = (dense @ (rng.rand(d) - 0.5) > 0).astype(np.float32)
+    loss = SPARSE_VARIANTS[BINARY_LOGISTIC_LOSS.name]
+    Xs = (indices, values)
+
+    ref = str(tmp_path / "ref")
+    expected, _, _ = _sgd(ref).optimize(np.zeros(d), Xs, y, None, loss)
+
+    ckpt = str(tmp_path / "kill")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.commit", after=5):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt).optimize(np.zeros(d), Xs, y, None, loss)
+        got, _, epochs = _sgd(ckpt).optimize(np.zeros(d), Xs, y, None, loss)
+    assert epochs == 12
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_stream_sgd_kill_resumes_without_reingest_bit_identical(tmp_path):
+    """Stream SGD with cache-CONTENTS shards: the kill-resumed fit is fed
+    an EMPTY stream — everything (model carry AND the packed data
+    segments) comes back from the sharded snapshot, bit-identically."""
+    from flink_ml_tpu.utils import metrics
+
+    X, y = _dense_problem(n=480)
+
+    def chunks():
+        return iter(
+            [(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)]
+        )
+
+    expected, _, _, _ = _sgd(max_iter=10).optimize_stream(
+        None, chunks(), BINARY_LOGISTIC_LOSS
+    )
+
+    ckpt = str(tmp_path / "stream")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.shard.write", after=4 * 3 + 2):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt, max_iter=10).optimize_stream(
+                    None, chunks(), BINARY_LOGISTIC_LOSS
+                )
+        before = metrics.get_counter("devicecache.contents.restored", 0)
+        got, _, epochs, _ = _sgd(ckpt, max_iter=10).optimize_stream(
+            None, iter([]), BINARY_LOGISTIC_LOSS  # resume never re-ingests
+        )
+        assert metrics.get_counter("devicecache.contents.restored", 0) > before
+    assert epochs == 10
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_stream_sgd_model_cut_bit_rot_falls_back_bit_identical(tmp_path):
+    """Bit rot on the newest model cut of a stream fit: fallback to the
+    previous cut, whose manifest still references the SAME stable cache
+    shards — data survives, resume is bit-identical (and still needs no
+    re-ingest)."""
+    from flink_ml_tpu.ckpt import coordinator
+
+    X, y = _dense_problem(n=480, seed=3)
+
+    def chunks():
+        return iter(
+            [(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)]
+        )
+
+    expected, _, _, _ = _sgd(max_iter=10).optimize_stream(
+        None, chunks(), BINARY_LOGISTIC_LOSS
+    )
+    ckpt = str(tmp_path / "stream")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("epoch", after=6):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt, max_iter=10).optimize_stream(
+                    None, chunks(), BINARY_LOGISTIC_LOSS
+                )
+        newest = coordinator.committed_cuts(ckpt, "fault")[-1]
+        with open(coordinator.shard_file(ckpt, "fault", newest, 1), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.warns(UserWarning, match="mismatch"):
+            got, _, epochs, _ = _sgd(ckpt, max_iter=10).optimize_stream(
+                None, iter([]), BINARY_LOGISTIC_LOSS
+            )
+    assert epochs == 10
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_mh_stream_sgd_corrupt_stable_cache_shard_fails_loudly(tmp_path):
+    """Bit rot on the DATA itself (a stable cache shard every manifest
+    references) leaves nothing trustworthy: the restore must refuse
+    loudly instead of silently training on corrupt bytes."""
+    from flink_ml_tpu.ckpt import SnapshotIntegrityError, coordinator
+
+    X, y = _dense_problem(n=480, seed=5)
+
+    def chunks():
+        return iter(
+            [(X[i : i + 120], y[i : i + 120], None) for i in range(0, 480, 120)]
+        )
+
+    ckpt = str(tmp_path / "stream")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("epoch", after=4):
+            with pytest.raises(InjectedFault):
+                _sgd(ckpt, max_iter=10).optimize_stream(
+                    None, chunks(), BINARY_LOGISTIC_LOSS
+                )
+        with open(
+            coordinator.stable_shard_file(ckpt, "fault", "cache", 0), "r+b"
+        ) as f:
+            f.seek(40)
+            f.write(b"\xde\xad\xbe\xef")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(SnapshotIntegrityError):
+                _sgd(ckpt, max_iter=10).optimize_stream(
+                    None, iter([]), BINARY_LOGISTIC_LOSS
+                )
+
+
+def test_mh_kmeans_out_of_core_kill_mid_commit_resume_bit_identical(tmp_path):
+    from flink_ml_tpu.models.clustering.kmeans import KMeans
+
+    rng = np.random.RandomState(7)
+    X = np.concatenate([rng.randn(200, 4) + 3.0, rng.randn(200, 4) - 3.0])
+    rng.shuffle(X)
+
+    def fit():
+        return (
+            KMeans().set_k(3).set_seed(11).set_max_iter(6)
+            .fit(_replayable_stream(X, chunk=80))
+        )
+
+    full = fit()
+
+    ckpt = str(tmp_path / "km")
+    with config.iteration_checkpointing(ckpt), config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.commit", after=3):
+            with pytest.raises(InjectedFault):
+                fit()
+        resumed = fit()
+    np.testing.assert_array_equal(resumed.centroids, full.centroids)
+    np.testing.assert_array_equal(resumed.weights, full.weights)

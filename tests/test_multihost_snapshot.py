@@ -1,0 +1,692 @@
+"""Multi-host snapshot coordination battery (flink_ml_tpu/ckpt/coordinator.py):
+the sharded-write + two-phase-commit-manifest protocol — per-host shard
+layout, per-shard and per-leaf integrity digests, the torn-manifest battery
+(kill mid-shard-write / mid-manifest-commit, manifest-without-shard, stale
+digests), straggler abort-this-cut, retention GC, refusals-never-retried,
+flaky-read retries, elastic N-host→M-host restore parity vs the single-file
+path, and the single-file path's new per-leaf crc32 verification."""
+
+import io
+import json
+import os
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import config
+from flink_ml_tpu.ckpt import (
+    InjectedFault,
+    SnapshotAborted,
+    SnapshotIntegrityError,
+    faults,
+    load_job_snapshot,
+    save_job_snapshot,
+    snapshot_file,
+    stage_section,
+)
+from flink_ml_tpu.ckpt import coordinator
+from flink_ml_tpu.utils import metrics
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _save(path, key="j", epoch=1, scale=1.0, hosts=4, meta=None):
+    jnp = _jnp()
+    return save_job_snapshot(
+        str(path),
+        key,
+        {
+            "model": (
+                jnp.arange(8.0) * scale,
+                jnp.arange(32.0).reshape(8, 4) * scale,
+                np.float64(scale),
+            )
+        },
+        epoch=epoch,
+        criteria=0.5,
+        specs={"model": ("replicated", "data", "host")},
+        meta=meta or {"numBatches": 4},
+        hosts=hosts,
+    )
+
+
+def _template():
+    jnp = _jnp()
+    return {"model": (jnp.zeros(8), jnp.zeros((8, 4)), np.float64(0))}
+
+
+def _load(path, key="j", **kw):
+    return load_job_snapshot(str(path), key, templates=_template(), **kw)
+
+
+def _corrupt(file, offset=60):
+    with open(file, "r+b") as f:
+        f.seek(offset)
+        f.write(b"\xde\xad\xbe\xef")
+
+
+# ---------------------------------------------------------------------------
+# format: shard layout, digests, manifest contents
+# ---------------------------------------------------------------------------
+
+def test_sharded_roundtrip_and_manifest_inventory(tmp_path):
+    target = _save(tmp_path, epoch=3, scale=2.0)
+    assert os.path.basename(target) == "snap-j.c000001.manifest.json"
+    with open(target) as f:
+        manifest = json.load(f)
+    assert manifest["formatVersion"] == coordinator.SHARDED_FORMAT_VERSION
+    assert manifest["hosts"] == 4
+    assert set(manifest["shards"]) == {
+        f"snap-j.c000001.host{h}.npz" for h in range(4)
+    }
+    for info in manifest["shards"].values():
+        assert {"crc32", "sha256", "bytes", "host"} <= set(info)
+    # leaf→shard layout: the data-tagged (8, 4) leaf splits 2 rows/host
+    parts = manifest["layout"]["s_model_1"]
+    assert [(p["start"], p["stop"]) for p in parts] == [
+        (0, 2), (2, 4), (4, 6), (6, 8)
+    ]
+    assert all(p["axis"] == 0 for p in parts)
+    # replicated + host leaves are whole-array, owned by host 0
+    assert manifest["layout"]["s_model_0"][0]["axis"] is None
+    assert manifest["layout"]["s_model_0"][0]["shard"].endswith("host0.npz")
+
+    snap = _load(tmp_path)
+    assert (snap.epoch, snap.criteria) == (3, 0.5)
+    c, r, host_leaf = snap.sections["model"]
+    np.testing.assert_array_equal(c, 2.0 * np.arange(8, dtype=np.float32))
+    np.testing.assert_array_equal(
+        r, 2.0 * np.arange(32, dtype=np.float32).reshape(8, 4)
+    )
+    assert float(host_leaf) == 2.0 and host_leaf.dtype == np.float64
+    assert snap.specs["model"] == ("replicated", "data", "host")
+
+
+def test_each_host_shard_holds_only_its_slice(tmp_path):
+    _save(tmp_path, scale=3.0)
+    for h in range(4):
+        with np.load(coordinator.shard_file(str(tmp_path), "j", 1, h)) as f:
+            if h == 0:
+                np.testing.assert_array_equal(
+                    f["s_model_0"], 3.0 * np.arange(8, dtype=np.float32)
+                )
+            else:
+                assert "s_model_0" not in f.files  # replicated: host 0 only
+            np.testing.assert_array_equal(
+                f["s_model_1"],
+                3.0
+                * np.arange(32, dtype=np.float32).reshape(8, 4)[
+                    2 * h : 2 * h + 2
+                ],
+            )
+
+
+def test_uneven_rows_and_surplus_hosts(tmp_path):
+    jnp = _jnp()
+    # 5 rows over 3 hosts (2/2/1) and 2 rows over 4 hosts (empty shards)
+    save_job_snapshot(
+        str(tmp_path),
+        "u",
+        {"model": (jnp.arange(10.0).reshape(5, 2), jnp.arange(2.0))},
+        epoch=1,
+        specs={"model": ("data", "data")},
+        hosts=3,
+    )
+    snap = load_job_snapshot(
+        str(tmp_path),
+        "u",
+        templates={"model": (jnp.zeros((5, 2)), jnp.zeros(2))},
+    )
+    np.testing.assert_array_equal(
+        snap.sections["model"][0], np.arange(10, dtype=np.float32).reshape(5, 2)
+    )
+    np.testing.assert_array_equal(
+        snap.sections["model"][1], np.arange(2, dtype=np.float32)
+    )
+
+
+def test_mesh_host_group_mapping():
+    import jax
+
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    assert mesh_lib.host_slice_bounds(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    assert mesh_lib.host_slice_bounds(5, 3) == [(0, 2), (2, 4), (4, 5)]
+    assert mesh_lib.host_slice_bounds(2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+    assert mesh_lib.shard_axis_for_tag("data", 2) == 0
+    assert mesh_lib.shard_axis_for_tag("model", 2) == 1
+    assert mesh_lib.shard_axis_for_tag("replicated", 2) is None
+    assert mesh_lib.shard_axis_for_tag("data", 0) is None  # scalars: whole
+    mesh = mesh_lib.create_mesh(("data",), devices=jax.devices()[:8])
+    groups = mesh_lib.host_groups(mesh, 4)
+    assert [len(g) for g in groups] == [2, 2, 2, 2]
+    assert sum(groups, []) == list(mesh.devices.flat)
+    with pytest.raises(ValueError):
+        mesh_lib.host_slice_bounds(8, 0)
+
+
+def test_model_tag_shards_trailing_axis(tmp_path):
+    jnp = _jnp()
+    save_job_snapshot(
+        str(tmp_path),
+        "m",
+        {"model": jnp.arange(24.0).reshape(2, 12)},
+        epoch=1,
+        specs={"model": "model"},
+        hosts=4,
+    )
+    with np.load(coordinator.shard_file(str(tmp_path), "m", 1, 2)) as f:
+        np.testing.assert_array_equal(
+            f["s_model_0"],
+            np.arange(24, dtype=np.float32).reshape(2, 12)[:, 6:9],
+        )
+    snap = load_job_snapshot(
+        str(tmp_path), "m", templates={"model": jnp.zeros((2, 12))}
+    )
+    np.testing.assert_array_equal(
+        snap.sections["model"], np.arange(24, dtype=np.float32).reshape(2, 12)
+    )
+
+
+# ---------------------------------------------------------------------------
+# torn-manifest battery
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_shard_write_leaves_previous_cut_restorable(tmp_path):
+    _save(tmp_path, epoch=1, scale=1.0)
+    # host 2 (the third shard write) dies after its temp file, before its
+    # rename — no manifest ever commits, the cut is torn
+    with faults.inject("snapshot.shard.write", after=3) as plan:
+        with pytest.raises(InjectedFault):
+            _save(tmp_path, epoch=2, scale=9.0)
+    assert plan.fired
+    snap = _load(tmp_path)
+    assert snap.epoch == 1
+    np.testing.assert_array_equal(
+        snap.sections["model"][0], np.arange(8, dtype=np.float32)
+    )
+    # the writer recovers: the next commit succeeds and GC sweeps the
+    # torn cut's orphaned shard files
+    _save(tmp_path, epoch=2, scale=2.0)
+    orphans = [
+        n
+        for n in os.listdir(tmp_path)
+        if coordinator._cut_of(n, "snap-j") == 2 and n.endswith(".npz")
+    ]
+    assert orphans == []
+    assert _load(tmp_path).epoch == 2
+
+
+def test_kill_mid_manifest_commit_leaves_previous_cut_restorable(tmp_path):
+    _save(tmp_path, epoch=1)
+    with faults.inject("snapshot.commit") as plan:
+        with pytest.raises(InjectedFault):
+            _save(tmp_path, epoch=2, scale=9.0)
+    assert plan.fired
+    # every shard of the torn cut landed, but the cut never committed
+    assert os.path.exists(coordinator.shard_file(str(tmp_path), "j", 2, 3))
+    assert not os.path.exists(coordinator.manifest_file(str(tmp_path), "j", 2))
+    assert _load(tmp_path).epoch == 1
+
+
+def test_torn_first_commit_is_a_fresh_start(tmp_path):
+    with faults.inject("snapshot.commit"):
+        with pytest.raises(InjectedFault):
+            _save(tmp_path, epoch=1)
+    assert _load(tmp_path) is None  # no committed cut ever existed
+
+
+def test_manifest_present_but_shard_missing_falls_back(tmp_path):
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2, scale=2.0)
+    os.remove(coordinator.shard_file(str(tmp_path), "j", 2, 1))
+    before = metrics.get_counter("checkpoint.restore.fallback", 0)
+    with pytest.warns(UserWarning, match="missing"):
+        snap = _load(tmp_path)
+    assert snap.epoch == 1  # fell back to the last committed intact cut
+    assert metrics.get_counter("checkpoint.restore.fallback", 0) == before + 1
+
+
+def test_stale_digest_shard_falls_back_and_counts(tmp_path):
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2, scale=2.0)
+    # "stale digest": the shard file is a VALID npz, just not the bytes
+    # the manifest committed (e.g. an older generation restored by a
+    # backup tool) — the digest refuses it
+    victim = coordinator.shard_file(str(tmp_path), "j", 2, 1)
+    np.savez(victim, s_model_1=np.zeros((2, 4), np.float32))
+    before = metrics.get_counter("checkpoint.digest.mismatch", 0)
+    with pytest.warns(UserWarning, match="mismatch"):
+        snap = _load(tmp_path)
+    assert snap.epoch == 1
+    assert metrics.get_counter("checkpoint.digest.mismatch", 0) == before + 1
+
+
+def test_all_cuts_corrupt_raises_loudly(tmp_path):
+    with config.snapshot_retention_mode(2):
+        _save(tmp_path, epoch=1)
+        _save(tmp_path, epoch=2)
+    for cut in (1, 2):
+        _corrupt(coordinator.shard_file(str(tmp_path), "j", cut, 0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(SnapshotIntegrityError, match="cannot produce"):
+            _load(tmp_path)
+
+
+def test_bit_rot_injection_mid_file(tmp_path):
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2, scale=5.0)
+    _corrupt(coordinator.shard_file(str(tmp_path), "j", 2, 2))
+    with pytest.warns(UserWarning, match="crc32 mismatch"):
+        snap = _load(tmp_path)
+    assert snap.epoch == 1
+
+
+def test_future_manifest_format_version_falls_back(tmp_path):
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2)
+    mfile = coordinator.manifest_file(str(tmp_path), "j", 2)
+    with open(mfile) as f:
+        manifest = json.load(f)
+    manifest["formatVersion"] = 99
+    with open(mfile, "w") as f:
+        json.dump(manifest, f)
+    with pytest.warns(UserWarning, match="format version 99"):
+        snap = _load(tmp_path)
+    assert snap.epoch == 1
+
+
+def test_meta_cursor_mismatch_refused_not_fallen_back(tmp_path):
+    """A meta refusal is about the JOB, not the cut: older cuts share the
+    layout, so the loader must bail (None) instead of restoring an older
+    cut that would be refused for the same reason."""
+    _save(tmp_path, epoch=1, meta={"numBatches": 4})
+    _save(tmp_path, epoch=2, meta={"numBatches": 4})
+    with pytest.warns(UserWarning, match="numBatches"):
+        snap = _load(tmp_path, expect_meta={"numBatches": 7})
+    assert snap is None
+    assert _load(tmp_path, expect_meta={"numBatches": 4}).epoch == 2
+
+
+def test_sharded_state_is_authoritative_over_stale_single_file(tmp_path):
+    """When committed sharded cuts exist, a refusal must NOT fall through
+    to an older single-file snapshot left behind by a format switch."""
+    jnp = _jnp()
+    save_job_snapshot(
+        str(tmp_path), "j", {"model": (jnp.zeros(8), jnp.zeros((8, 4)),
+                                       np.float64(0))},
+        epoch=7, meta={"numBatches": 4},
+    )  # single-file, hosts=None
+    assert os.path.exists(snapshot_file(str(tmp_path), "j"))
+    _save(tmp_path, epoch=9)
+    with pytest.warns(UserWarning, match="numBatches"):
+        snap = _load(tmp_path, expect_meta={"numBatches": 7})
+    assert snap is None  # NOT the epoch-7 single file
+
+
+# ---------------------------------------------------------------------------
+# straggler abort-this-cut
+# ---------------------------------------------------------------------------
+
+def test_straggler_host_aborts_cut_previous_restorable(tmp_path):
+    _save(tmp_path, epoch=1)
+    before = metrics.get_counter("checkpoint.abort", 0)
+    with config.transient_retry_mode(1):
+        with faults.flaky("snapshot.shard.write", times=99):
+            with pytest.warns(UserWarning, match="aborted"):
+                out = _save(tmp_path, epoch=2, scale=9.0)
+    assert out is None  # the cut was abandoned, not committed
+    assert metrics.get_counter("checkpoint.abort", 0) == before + 1
+    # no partial files of the aborted cut survive
+    leftovers = [
+        n for n in os.listdir(tmp_path) if coordinator._cut_of(n, "snap-j") == 2
+    ]
+    assert leftovers == []
+    assert _load(tmp_path).epoch == 1
+    # the job recovered: the next boundary commits normally
+    assert _save(tmp_path, epoch=3, scale=3.0) is not None
+    assert _load(tmp_path).epoch == 3
+
+
+def test_straggler_deadline_bounds_the_wait(tmp_path):
+    """With a 0-second host deadline every transient failure exhausts
+    immediately — the cut aborts on the first blip instead of spinning
+    through the retry budget."""
+    _save(tmp_path, epoch=1)
+    prev = config.snapshot_host_deadline_s
+    config.snapshot_host_deadline_s = 0.0
+    try:
+        with config.transient_retry_mode(50):
+            with faults.flaky("snapshot.shard.write", times=1) as plan:
+                with pytest.warns(UserWarning, match="aborted"):
+                    assert _save(tmp_path, epoch=2) is None
+    finally:
+        config.snapshot_host_deadline_s = prev
+    assert plan.failures == 1  # one attempt, no retry spin
+    assert _load(tmp_path).epoch == 1
+
+
+def test_transient_shard_write_retried_within_budget(tmp_path):
+    with config.transient_retry_mode(3):
+        with faults.flaky("snapshot.shard.write", times=2) as plan:
+            assert _save(tmp_path, epoch=4, scale=4.0) is not None
+    assert plan.failures == 2
+    assert _load(tmp_path).epoch == 4
+
+
+# ---------------------------------------------------------------------------
+# retention + GC
+# ---------------------------------------------------------------------------
+
+def test_retention_keeps_last_n_cuts(tmp_path):
+    with config.snapshot_retention_mode(3):
+        for e in range(1, 6):
+            _save(tmp_path, epoch=e, scale=float(e))
+    cuts = coordinator.committed_cuts(str(tmp_path), "j")
+    assert cuts == [3, 4, 5]
+    files = os.listdir(tmp_path)
+    assert not any(coordinator._cut_of(n, "snap-j") in (1, 2) for n in files)
+    # rollback-to-previous-cut is possible: corrupt newest, get cut 4
+    _corrupt(coordinator.shard_file(str(tmp_path), "j", 5, 0))
+    with pytest.warns(UserWarning):
+        snap = _load(tmp_path)
+    assert snap.epoch == 4
+
+
+def test_gc_removes_stale_temps_and_unreferenced_stable_shards(tmp_path):
+    _save(tmp_path, epoch=1)
+    stray_tmp = os.path.join(
+        str(tmp_path), "snap-j.c000001.host9.tmp.npz"
+    )
+    stray_stable = os.path.join(
+        str(tmp_path), "snap-j.stable-cache.host0.npz"
+    )
+    np.savez(stray_tmp, x=np.zeros(1))
+    np.savez(stray_stable, x=np.zeros(1))
+    _save(tmp_path, epoch=2)
+    assert not os.path.exists(stray_tmp)
+    assert not os.path.exists(stray_stable)
+    assert _load(tmp_path).epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# retries: flaky reads retried, refusals NEVER retried
+# ---------------------------------------------------------------------------
+
+def test_flaky_manifest_and_shard_reads_retried_to_success(tmp_path):
+    _save(tmp_path, epoch=6, scale=6.0)
+    with config.transient_retry_mode(3):
+        with faults.flaky("snapshot.manifest.read", times=2) as mplan:
+            snap = _load(tmp_path)
+        assert snap.epoch == 6
+        with faults.flaky("snapshot.shard.read", times=2) as splan:
+            snap = _load(tmp_path)
+        assert snap.epoch == 6
+    assert mplan.failures == 2 and splan.failures == 2
+    np.testing.assert_array_equal(
+        snap.sections["model"][0], 6.0 * np.arange(8, dtype=np.float32)
+    )
+
+
+def test_flaky_read_budget_exhausted_reraises_original(tmp_path):
+    from flink_ml_tpu.ckpt.faults import TransientFault
+
+    _save(tmp_path, epoch=1)
+    with config.transient_retry_mode(1):
+        with faults.flaky("snapshot.shard.read", times=10):
+            with pytest.raises(TransientFault) as ei:
+                _load(tmp_path)
+    assert ei.value.retry_attempts == 2
+
+
+def test_refusals_are_never_retried(tmp_path):
+    """Digest mismatch and format-version refusals are decisions — the
+    retry counters must not move while the loader falls back."""
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2)
+    _corrupt(coordinator.shard_file(str(tmp_path), "j", 2, 0))
+    before = metrics.get_counter("flow.retry", 0)
+    with config.transient_retry_mode(5):
+        with pytest.warns(UserWarning, match="mismatch"):
+            snap = _load(tmp_path)
+    assert snap.epoch == 1
+    assert metrics.get_counter("flow.retry", 0) == before
+
+
+# ---------------------------------------------------------------------------
+# single-file path: per-leaf crc32 digests (satellite)
+# ---------------------------------------------------------------------------
+
+def _rewrite_single_file_leaf(file, leaf_key, new_array):
+    with np.load(file) as f:
+        arrays = {k: f[k] for k in f.files}
+    arrays[leaf_key] = new_array  # the manifest (and its crc32s) stay put
+    manifest = arrays.pop("manifest")
+    np.savez(file, manifest=manifest, **arrays)
+
+
+def test_single_file_corrupt_leaf_fails_loudly_naming_leaf(tmp_path):
+    jnp = _jnp()
+    file = save_job_snapshot(
+        str(tmp_path),
+        "sf",
+        {"model": (jnp.arange(4.0), jnp.ones(3))},
+        epoch=2,
+    )
+    _rewrite_single_file_leaf(file, "s_model_1", np.full(3, 7.0, np.float32))
+    with pytest.raises(SnapshotIntegrityError, match="s_model_1"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            load_job_snapshot(
+                str(tmp_path),
+                "sf",
+                templates={"model": (jnp.zeros(4), jnp.zeros(3))},
+            )
+
+
+def test_single_file_digest_failure_not_retried(tmp_path):
+    jnp = _jnp()
+    file = save_job_snapshot(
+        str(tmp_path), "sf", {"model": jnp.arange(4.0)}, epoch=1
+    )
+    _rewrite_single_file_leaf(file, "s_model_0", np.zeros(4, np.float32))
+    before = metrics.get_counter("flow.retry.snapshot.read", 0)
+    with config.transient_retry_mode(5):
+        with pytest.raises(SnapshotIntegrityError):
+            load_job_snapshot(
+                str(tmp_path), "sf", templates={"model": jnp.zeros(4)}
+            )
+    assert metrics.get_counter("flow.retry.snapshot.read", 0) == before
+
+
+def test_single_file_pre_digest_snapshot_still_loads(tmp_path):
+    """Snapshots written before the digest satellite (no crc32 entries)
+    load without verification — additive format evolution."""
+    jnp = _jnp()
+    file = save_job_snapshot(
+        str(tmp_path), "old", {"model": jnp.arange(4.0)}, epoch=3
+    )
+    with np.load(file) as f:
+        arrays = {k: f[k] for k in f.files}
+    manifest = json.loads(str(arrays.pop("manifest")))
+    for section in manifest["sections"].values():
+        for entry in section["leaves"]:
+            entry.pop("crc32", None)
+    np.savez(file, manifest=np.asarray(json.dumps(manifest)), **arrays)
+    snap = load_job_snapshot(
+        str(tmp_path), "old", templates={"model": jnp.zeros(4)}
+    )
+    assert snap is not None and snap.epoch == 3
+
+
+def test_legacy_reader_warns_it_cannot_verify(tmp_path):
+    from flink_ml_tpu.parallel.iteration import save_iteration_checkpoint
+
+    jnp = _jnp()
+    carry = (jnp.asarray([1.0, 2.0]),)
+    save_iteration_checkpoint(str(tmp_path), carry, epoch=3, criteria=0.5,
+                              job_key="lg")
+    with pytest.warns(UserWarning, match="CANNOT be verified"):
+        snap = load_job_snapshot(str(tmp_path), "lg", templates={"model": carry})
+    assert snap is not None and snap.epoch == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic: N-host shards onto M-host meshes, parity vs single-file
+# ---------------------------------------------------------------------------
+
+def test_stage_section_reshards_sharded_snapshot_onto_other_meshes(tmp_path):
+    import jax
+
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    _save(tmp_path, epoch=1, scale=4.0, hosts=8)
+    snap = _load(tmp_path)
+    for n_dev in (1, 2, 8):
+        mesh = mesh_lib.create_mesh(("data",), devices=jax.devices()[:n_dev])
+        c, r, host_leaf = stage_section(snap, "model", mesh=mesh)
+        assert isinstance(c, jax.Array) and isinstance(r, jax.Array)
+        assert r.sharding.spec == mesh_lib.data_sharding(mesh, 2).spec
+        np.testing.assert_array_equal(
+            np.asarray(r),
+            4.0 * np.arange(32, dtype=np.float32).reshape(8, 4),
+        )
+        assert isinstance(host_leaf, np.ndarray)
+
+
+@pytest.mark.parametrize("from_hosts,to_hosts", [(1, 8), (8, 2)])
+def test_sharded_snapshot_rewrites_across_host_counts(tmp_path, from_hosts, to_hosts):
+    """Write on N hosts, restore, re-save on M hosts, restore again: the
+    leaves survive both transports bit-for-bit (elastic N→M, both
+    directions, independent of mesh device count)."""
+    _save(tmp_path / "a", epoch=1, scale=7.0, hosts=from_hosts)
+    snap = _load(tmp_path / "a")
+    jnp = _jnp()
+    save_job_snapshot(
+        str(tmp_path / "b"),
+        "j",
+        {"model": tuple(jnp.asarray(leaf) if i < 2 else leaf
+                        for i, leaf in enumerate(snap.sections["model"]))},
+        epoch=1,
+        specs={"model": ("replicated", "data", "host")},
+        hosts=to_hosts,
+    )
+    again = _load(tmp_path / "b")
+    for a, b in zip(snap.sections["model"], again.sections["model"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("from_dev,to_dev", [(1, 8), (8, 2)])
+def test_elastic_sharded_resume_parity_with_single_file(tmp_path, from_dev, to_dev):
+    """THE elastic acceptance: a dense SGD fit killed on an N-device mesh
+    with 4-host SHARDED snapshots, resumed on an M-device mesh, lands on
+    the exact coefficients of the same kill/resume through the
+    single-file path — the sharded transport is lossless end to end."""
+    import jax
+
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.RandomState(4)
+    X = rng.randn(384, 8).astype(np.float32)
+    y = (X @ np.linspace(1, -1, 8) > 0).astype(np.float32)
+
+    def fit_on(n_dev, ckpt, max_iter):
+        mesh = mesh_lib.create_mesh(("data",), devices=jax.devices()[:n_dev])
+        with mesh_lib.use_mesh(mesh):
+            return SGD(
+                max_iter=max_iter, global_batch_size=96, tol=0.0,
+                checkpoint_dir=ckpt, checkpoint_key="el",
+            ).optimize(np.zeros(8), X, y, None, BINARY_LOGISTIC_LOSS)
+
+    single = str(tmp_path / "single")
+    with faults.inject("chunk", after=6):
+        with pytest.raises(InjectedFault):
+            fit_on(from_dev, single, 12)
+    single_coeff, _, single_epochs = fit_on(to_dev, single, 12)
+
+    sharded = str(tmp_path / "sharded")
+    with config.snapshot_hosts_mode(4):
+        with faults.inject("chunk", after=6):
+            with pytest.raises(InjectedFault):
+                fit_on(from_dev, sharded, 12)
+        assert coordinator.has_sharded(sharded, "el")
+        sharded_coeff, _, sharded_epochs = fit_on(to_dev, sharded, 12)
+    assert single_epochs == sharded_epochs == 12
+    np.testing.assert_array_equal(
+        np.asarray(sharded_coeff), np.asarray(single_coeff)
+    )
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_sharded_counters(tmp_path):
+    before_shards = metrics.get_counter("checkpoint.shard.count", 0)
+    before_manifests = metrics.get_counter("checkpoint.manifest.count", 0)
+    before_count = metrics.get_counter("checkpoint.count", 0)
+    _save(tmp_path, epoch=1)
+    assert metrics.get_counter("checkpoint.shard.count", 0) == before_shards + 4
+    assert (
+        metrics.get_counter("checkpoint.manifest.count", 0)
+        == before_manifests + 1
+    )
+    assert metrics.get_counter("checkpoint.count", 0) == before_count + 1
+    assert metrics.get_counter("checkpoint.shard.bytes", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# unbounded (online) loop: sharded resume + completion purge
+# ---------------------------------------------------------------------------
+
+def test_online_unbounded_sharded_resume_and_completion_purge(tmp_path):
+    """The online loop under sharded snapshots: a kill between global
+    batches resumes from the committed cut (replayed prefix skipped), and
+    a COMPLETED stream purges every sharded file so a new job cannot
+    resume past a finished run."""
+    from flink_ml_tpu.parallel.iteration import iterate_unbounded
+
+    jnp = _jnp()
+    d = str(tmp_path / "online")
+    batches = [np.full(3, float(i)) for i in range(1, 6)]
+
+    def run(n_batches=5):
+        return list(
+            iterate_unbounded(
+                iter(batches[:n_batches]),
+                lambda s, b: s + jnp.asarray(b),
+                jnp.zeros(3),
+                checkpoint_dir=d,
+                job_key="ol",
+            )
+        )
+
+    expected = [np.asarray(s) for _, s in run()]  # uninterrupted (and purged)
+    assert coordinator.committed_cuts(d, "ol") == []  # completion purge
+
+    with config.snapshot_hosts_mode(2):
+        with faults.inject("batch", after=3):
+            with pytest.raises(InjectedFault):
+                run()
+        assert coordinator.committed_cuts(d, "ol") != []
+        versions_states = run()
+    # the restored version is republished first, then the remainder folds
+    assert versions_states[0][0] == 3
+    np.testing.assert_array_equal(
+        np.asarray(versions_states[-1][1]), expected[-1]
+    )
+    assert versions_states[-1][0] == 5
+    # completed again: every sharded file purged
+    assert coordinator.committed_cuts(d, "ol") == []
+    assert not any(
+        n.startswith("snap-ol.") for n in os.listdir(d)
+    )

@@ -1989,3 +1989,133 @@ class TestResidentProgram:
             "ops/__init__.py": "",
         }, ["resident-program"])
         assert any(f.rule == "unused-suppression" for f in stale.findings)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-commit
+# ---------------------------------------------------------------------------
+
+class TestSnapshotCommit:
+    def test_true_positive_raw_savez_and_replace_in_ckpt(self, tmp_path):
+        report = _run(tmp_path, {
+            "ckpt/rogue.py": """
+                import json
+                import os
+
+                import numpy as np
+
+                def hand_rolled_commit(target, arrays):
+                    tmp = target + ".tmp"
+                    np.savez(tmp, **arrays)
+                    os.replace(tmp, target)
+            """,
+            "ckpt/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert len(report.findings) == 2
+        kinds = {f.data[1] for f in report.findings}
+        assert kinds == {"np.savez", "os.replace"}
+        assert all(f.rule == "snapshot-commit" for f in report.findings)
+
+    def test_true_positive_raw_json_dump_open_w(self, tmp_path):
+        report = _run(tmp_path, {
+            "ckpt/manifesto.py": """
+                import json
+
+                def write_manifest(path, manifest):
+                    with open(path, "w") as f:
+                        json.dump(manifest, f)
+            """,
+            "ckpt/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert len(report.findings) == 2  # the open(w) AND the dump
+        assert {f.data[1] for f in report.findings} == {"open(..., 'w')", "json.dump"}
+
+    def test_true_positive_os_rename_in_ckpt(self, tmp_path):
+        report = _run(tmp_path, {
+            "ckpt/mover.py": """
+                import os
+
+                def publish(tmp, target):
+                    os.rename(tmp, target)
+            """,
+            "ckpt/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("write", "os.rename")
+
+    def test_true_negative_atomic_commit_machinery(self, tmp_path):
+        """The helper itself, inline-lambda payloads, AND named payload
+        writers referenced from an atomic_commit call are all sanctioned;
+        reads and deletes are not commits."""
+        report = _run(tmp_path, {
+            "ckpt/coordinator.py": """
+                import json
+                import os
+
+                import numpy as np
+
+                def atomic_commit(target, write_payload, *, site):
+                    tmp = target + ".tmp"
+                    write_payload(tmp)
+                    os.replace(tmp, target)
+
+                def _dump_json(tmp, manifest):
+                    with open(tmp, "w") as f:
+                        json.dump(manifest, f)
+
+                def save(target, arrays, manifest):
+                    atomic_commit(
+                        target, lambda tmp: np.savez(tmp, **arrays), site="s"
+                    )
+                    atomic_commit(
+                        target + ".json",
+                        lambda tmp: _dump_json(tmp, manifest),
+                        site="s",
+                    )
+
+                def gc(path):
+                    os.remove(path)  # a delete is not a commit
+
+                def read(path):
+                    with open(path, "rb") as f:
+                        return f.read()
+            """,
+            "ckpt/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert report.findings == []
+
+    def test_true_negative_writes_outside_ckpt(self, tmp_path):
+        report = _run(tmp_path, {
+            "parallel/iteration.py": """
+                import os
+
+                import numpy as np
+
+                def legacy_writer(target, leaves):
+                    tmp = target + ".tmp"
+                    np.savez(tmp, **leaves)
+                    os.replace(tmp, target)
+            """,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert report.findings == []
+
+    def test_suppression_with_reason(self, tmp_path):
+        report = _run(tmp_path, {
+            "ckpt/debugdump.py": """
+                import numpy as np
+
+                def dump_for_postmortem(path, arrays):
+                    # tpulint: disable=snapshot-commit -- postmortem scratch dump, never read back as a checkpoint
+                    np.savez(path, **arrays)
+            """,
+            "ckpt/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["snapshot-commit"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
