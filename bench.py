@@ -989,6 +989,133 @@ def bench_multihost_checkpoint(
     return result
 
 
+def bench_elastic_recovery(n=100_000, d=32, max_iter=12, hosts=4):
+    """Elastic-supervisor workload (ISSUE 15): a checkpointed dense SGD
+    fit under `parallel/supervisor.supervise` with sharded snapshots,
+    chaos-injected twice: (a) a collective HANG mid-drain — detected by
+    the dispatch-progress deadline, host readmitted, SAME-host-count
+    resume asserted BIT-IDENTICAL to the unkilled fit; (b) a host DEATH
+    mid-epoch — detected by heartbeat timeout, host quarantined, mesh
+    re-formed over survivors, cross-count resume asserted allclose per
+    the reduction-order caveat. Reports per scenario: detection latency
+    (fault observable -> monitor detected) and recovery wall (detected ->
+    resumed fit's first progress); top-level detectionMs/recoveryWallMs
+    are the worst of the two (the conservative SLO numbers the CI
+    bench_diff rules gate)."""
+    import shutil
+    import tempfile
+
+    from flink_ml_tpu import config as _config
+    from flink_ml_tpu.ckpt import faults
+    from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.parallel import supervisor
+
+    rng = np.random.default_rng(31)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.float32)
+
+    def make_fit(ckpt_dir):
+        def fit(mesh):
+            return SGD(
+                max_iter=max_iter, global_batch_size=20_000, tol=0.0,
+                checkpoint_dir=ckpt_dir, checkpoint_interval=1,
+                checkpoint_key="elasticRecovery",
+            ).optimize(
+                np.zeros(d, np.float32), X, y, None,
+                BINARY_LOGISTIC_LOSS, mesh=mesh,
+            )
+
+        return fit
+
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    scenarios = {}
+    try:
+        from flink_ml_tpu.parallel import mesh as mesh_lib
+
+        expected, _, _ = make_fit(os.path.join(work, "ref"))(
+            mesh_lib.default_mesh()
+        )
+        expected = np.asarray(expected)
+
+        with _config.snapshot_hosts_mode(hosts):
+            # (a) collective hang mid-drain: readmit, bit-identical resume
+            hang_dir = os.path.join(work, "hang")
+            with faults.inject("host.hang.collective", after=3):
+                t0 = time.perf_counter()
+                res = supervisor.supervise(
+                    make_fit(hang_dir), hosts=hosts,
+                    checkpoint_dir=hang_dir, job_key="elasticRecovery",
+                    heartbeat_timeout_s=30.0, poll_interval_s=0.005,
+                )
+                hang_wall = time.perf_counter() - t0
+            assert res.recoveries == 1 and res.hosts == hosts
+            (ev,) = res.events
+            assert ev.kind == "collectiveHang"
+            coeff, _, epochs = res.value
+            assert epochs == max_iter
+            assert np.array_equal(np.asarray(coeff), expected), (
+                "same-host-count elastic resume diverged from the unkilled fit"
+            )
+            scenarios["hang"] = {
+                "detectionMs": ev.detection_ms,
+                "recoveryWallMs": ev.recovery_ms,
+                "supervisedWallMs": hang_wall * 1000.0,
+                "hostsAfter": res.hosts,
+                "bitIdentical": True,  # asserted above
+            }
+
+            # (b) host death mid-epoch: quarantine + shrink, allclose resume
+            die_dir = os.path.join(work, "die")
+            with faults.inject("host.die.dispatch", after=3):
+                t0 = time.perf_counter()
+                res = supervisor.supervise(
+                    make_fit(die_dir), hosts=hosts,
+                    checkpoint_dir=die_dir, job_key="elasticRecovery",
+                    heartbeat_timeout_s=0.25, poll_interval_s=0.005,
+                )
+                die_wall = time.perf_counter() - t0
+            assert res.recoveries == 1 and res.hosts == hosts - 1
+            (ev,) = res.events
+            assert ev.kind == "hostFailure" and ev.quarantined
+            coeff, _, epochs = res.value
+            assert epochs == max_iter
+            assert np.allclose(np.asarray(coeff), expected, rtol=5e-4, atol=1e-6), (
+                "shrink resume diverged beyond the reduction-order envelope"
+            )
+            scenarios["hostDeath"] = {
+                "detectionMs": ev.detection_ms,
+                "recoveryWallMs": ev.recovery_ms,
+                "supervisedWallMs": die_wall * 1000.0,
+                "hostsAfter": res.hosts,
+                "allclose": True,  # asserted above
+            }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    result = {
+        "numRows": n,
+        "dim": d,
+        "maxIter": max_iter,
+        "hosts": hosts,
+        **scenarios,
+        "detectionMs": max(s["detectionMs"] for s in scenarios.values()),
+        "recoveryWallMs": max(
+            s["recoveryWallMs"] or 0.0 for s in scenarios.values()
+        ),
+        "parityAsserted": True,
+    }
+    log(
+        f"elasticRecovery: hang detected {scenarios['hang']['detectionMs']:.0f}ms"
+        f" / recovered {scenarios['hang']['recoveryWallMs']:.0f}ms"
+        " (bit-identical resume), host death detected "
+        f"{scenarios['hostDeath']['detectionMs']:.0f}ms / recovered "
+        f"{scenarios['hostDeath']['recoveryWallMs']:.0f}ms "
+        f"({hosts}->{hosts - 1} hosts, allclose)"
+    )
+    return result
+
+
 def bench_overload_soak(num_requests=60, batch_rows=256, d=24):
     """Robustness workload (ISSUE 8): bursty producer x slow/flaky
     consumer, asserted in-process:
@@ -1387,6 +1514,7 @@ def main(argv):
         "wholeFitDispatch": None,
         "checkpointResume": None,
         "multiHostCheckpoint": None,
+        "elasticRecovery": None,
         "overloadSoak": None,
         "hotSwapSoak": None,
         "multichipCollectives": None,
@@ -1488,6 +1616,12 @@ def main(argv):
                 details["multiHostCheckpoint"] = bench_multihost_checkpoint()
             except Exception as e:
                 log(f"multiHostCheckpoint stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["elasticRecovery"] = bench_elastic_recovery()
+            except Exception as e:
+                log(f"elasticRecovery stage failed: {e!r}")
 
         if in_budget():
             try:

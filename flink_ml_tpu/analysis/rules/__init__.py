@@ -17,4 +17,5 @@ from . import (  # noqa: F401
     shardingtags,
     snapshotcommit,
     specconsistency,
+    untimedwait,
 )

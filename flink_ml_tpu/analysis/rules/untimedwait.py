@@ -1,0 +1,171 @@
+"""untimed-wait: indefinite blocking calls outside the flow layer.
+
+The elastic supervisor (parallel/supervisor.py) exists because a blocked
+collective is invisible to everything except a deadline — and the same
+failure shape hides in plain host code: a `Condition.wait()`,
+`Event.wait()`, `Thread.join()` or queue/channel `.get()` WITHOUT a
+timeout is a thread betting its liveness on another thread it cannot
+observe. When that peer dies (the silently-dead-producer stall
+`flow.pump`'s close-with-error contract kills) or wedges, the waiter
+hangs forever, no counter moves, and the only recovery is a human with a
+stack dump. `flow.py` is the one sanctioned home for indefinite waits —
+its channel protocol pairs every wait with a close/cancel wake-up — so
+everywhere else a blocking call must carry a timeout (loop on it if the
+wait is legitimately long) or a suppression stating what guarantees the
+wake-up.
+
+Flagged:
+
+- ``x.wait()`` / ``x.wait(timeout=None)`` — Condition/Event waits with
+  no deadline;
+- ``x.join()`` with no timeout — a Thread join that outlives a wedged
+  worker forever (``", ".join(parts)`` takes an argument and is never
+  flagged);
+- ``x.get()`` with no arguments when ``x`` is queue-like: assigned from
+  a ``BoundedChannel(...)`` / ``queue.Queue(...)``-family constructor in
+  this module, or named like one (``*queue``, ``*channel``, ``*window``,
+  ``*_q``). Dict/contextvar ``.get`` always carries an argument or a
+  non-queue receiver and stays quiet.
+
+Suppression etiquette (docs/static_analysis.md): a wait whose wake-up is
+structurally guaranteed carries
+``# tpulint: disable=untimed-wait -- <what guarantees the wake-up>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from ..engine import Finding, Rule, register
+from ..source import SourceModule
+
+#: Receiver names that read as queues even without a visible constructor.
+_QUEUEISH_NAME = re.compile(r"(queue|channel|chan|window)$|_q$|^q$", re.I)
+
+#: Constructors whose results are queue-like (the `.get()` heuristic).
+_QUEUE_CONSTRUCTORS = (
+    "BoundedChannel",
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "SimpleQueue",
+)
+
+
+def _timeout_given(node: ast.Call) -> bool:
+    """Does this call pass any deadline? A positional arg counts (wait's
+    and join's first parameter IS the timeout); `timeout=None` does not."""
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+    return bool(node.args)
+
+
+def _terminal_name(expr: ast.expr) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _queueish_targets(tree: ast.AST) -> Set[str]:
+    """Names (locals AND self-attributes) assigned from a queue-like
+    constructor anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        ctor = _terminal_name(node.value.func)
+        if ctor not in _QUEUE_CONSTRUCTORS:
+            continue
+        for target in node.targets:
+            name = _terminal_name(target)
+            if name:
+                out.add(name)
+    return out
+
+
+@register
+class UntimedWaitRule(Rule):
+    id = "untimed-wait"
+    title = "indefinite blocking calls outside the flow layer"
+    rationale = (
+        "A wait()/join()/get() without a timeout bets a thread's "
+        "liveness on a peer it cannot observe: when the peer dies or "
+        "wedges, the waiter hangs forever and no counter moves — the "
+        "failure shape the elastic supervisor's hang watchdog exists "
+        "to catch at the fit level. flow.py is the sanctioned home for "
+        "indefinite waits (its channel protocol pairs every wait with "
+        "a close/cancel wake-up); everywhere else, pass a timeout and "
+        "loop, or suppress WITH the reason that guarantees the wake-up."
+    )
+    example = "done.wait()  # use done.wait(timeout) in a loop"
+    scope = ("flink_ml_tpu",)
+    exclude = ("flink_ml_tpu/flow.py",)
+
+    def check_module(
+        self, project, module: SourceModule
+    ) -> Iterable[Finding]:
+        if module.tree is None:
+            return ()
+        queueish = _queueish_targets(module.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            meth = node.func.attr
+            if meth == "wait":
+                if not _timeout_given(node):
+                    findings.append(
+                        Finding(
+                            path=module.path,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=(
+                                "untimed .wait() — blocks forever if the "
+                                "notifier dies; pass a timeout and loop"
+                            ),
+                            data=("wait",),
+                        )
+                    )
+            elif meth == "join":
+                if not node.args and not _timeout_given(node):
+                    findings.append(
+                        Finding(
+                            path=module.path,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=(
+                                "untimed .join() — outlives a wedged worker "
+                                "forever; pass join(timeout=...) and check "
+                                "is_alive()"
+                            ),
+                            data=("join",),
+                        )
+                    )
+            elif meth == "get" and not node.args and not node.keywords:
+                recv = _terminal_name(node.func.value)
+                if recv in queueish or _QUEUEISH_NAME.search(recv or ""):
+                    findings.append(
+                        Finding(
+                            path=module.path,
+                            line=node.lineno,
+                            rule=self.id,
+                            message=(
+                                f"untimed {recv}.get() on a queue/channel — "
+                                "blocks forever on a dead producer; pass "
+                                "get(timeout=...) or prove non-blocking and "
+                                "suppress with the reason"
+                            ),
+                            data=("get",),
+                        )
+                    )
+        return findings

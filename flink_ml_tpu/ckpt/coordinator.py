@@ -93,6 +93,7 @@ __all__ = [
     "save_sharded",
     "load_sharded",
     "gc_snapshots",
+    "sweep_uncommitted",
 ]
 
 #: version of the sharded manifest CONTAINER (the per-leaf payload format
@@ -235,6 +236,19 @@ def _digests(data: bytes) -> Dict[str, Any]:
     }
 
 
+def _remove_quiet(path: str) -> bool:
+    """Idempotent delete for the cleanup paths (abort sweep, GC,
+    uncommitted-cut sweep): these can legally race each other — a
+    straggler abort racing commit-time retention GC — and losing the
+    race to delete a file someone else already deleted is success, not
+    an error."""
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # save: per-host shard writes + manifest commit
 # ---------------------------------------------------------------------------
@@ -282,13 +296,21 @@ def _write_host_shards(
     host_payloads: List[Dict[str, np.ndarray]],
     *,
     deadline_s: Optional[float],
+    written: Optional[List[str]] = None,
 ) -> Dict[str, Dict[str, Any]]:
     """Phase 1: every host commits its own shard file (the per-host
     `snapshot.shard.write` kill site lives inside each commit), then the
     coordinator digests the landed bytes. A straggler host — transient
-    retries/deadline exhausted — aborts the cut."""
+    retries/deadline exhausted — aborts the cut. Each landed target is
+    appended to `written` BEFORE the next host starts, so a failure
+    mid-loop can sweep exactly the files this cut put on disk. Under a
+    supervised fit each host's write is also a `commit` host-health
+    boundary (parallel/supervisor.py) — the mid-commit chaos axis."""
+    from ..parallel import supervisor
+
     shards: Dict[str, Dict[str, Any]] = {}
     for h, file in enumerate(files):
+        supervisor.pulse_boundary(supervisor.PHASE_COMMIT)
         payload = host_payloads[h]
         try:
             atomic_commit(
@@ -303,6 +325,8 @@ def _write_host_shards(
                 f"within its retry budget/deadline "
                 f"(attempts={getattr(e, 'retry_attempts', '?')}): {e}"
             ) from e
+        if written is not None:
+            written.append(file)
         data = _read_file_bytes(file, "snapshot.shard.read")
         info = _digests(data)
         info["host"] = h
@@ -397,10 +421,15 @@ def save_sharded(
                 arrays, entry["key"], entry["spec"], hosts, host_payloads, files
             )
 
+    written: List[str] = []  # every target THIS call committed (sweep set)
+    cut_files = list(files)  # candidates whose temps must also be swept
     try:
         # phase 1: per-host shard commits (+ digests of the landed bytes)
         shards = _write_host_shards(
-            files, host_payloads, deadline_s=config.snapshot_host_deadline_s
+            files,
+            host_payloads,
+            deadline_s=config.snapshot_host_deadline_s,
+            written=written,
         )
 
         # stable sections: written once per job key, reused by reference
@@ -442,24 +471,48 @@ def save_sharded(
                     sarrays, key, tag, hosts, spayloads, sfiles
                 )
             manifest_sections[name] = {"leaves": entries}
+            cut_files.extend(sfiles)
             shards.update(
                 _write_host_shards(
-                    sfiles, spayloads, deadline_s=config.snapshot_host_deadline_s
+                    sfiles,
+                    spayloads,
+                    deadline_s=config.snapshot_host_deadline_s,
+                    written=written,
                 )
             )
             for base in (os.path.basename(f) for f in sfiles):
                 shards[base]["stable"] = True
-    except SnapshotAborted:
+    except BaseException as e:
         # abort-this-cut: remove everything this cut managed to land —
-        # the previous committed snapshot is untouched and restorable
-        for file in files:
-            for victim in (file, _tmp_of(file)):
-                if os.path.exists(victim):
-                    os.remove(victim)
-        metrics.inc_counter("checkpoint.abort")
+        # on the planned straggler abort AND on any unexpected exception
+        # mid-cut (an injected kill, a supervisor abort): partial shard
+        # files must never wait for the next commit's GC. Only files
+        # carrying THIS cut's id (plus temps) are ours to delete: a
+        # stable TARGET this save (re)wrote lives at a cut-less shared
+        # path that committed manifests reference — its atomic overwrite
+        # carries the same immutable bytes, so it must survive the sweep
+        # (only its temp is swept). The previous committed snapshot is
+        # untouched and restorable either way.
+        base = _base(job_key)
+        for victim in set(written) | {_tmp_of(f) for f in cut_files}:
+            name = os.path.basename(victim)
+            if _cut_of(name, base) is None and ".tmp" not in name:
+                continue
+            _remove_quiet(victim)
+        metrics.inc_counter(
+            "checkpoint.abort"
+            if isinstance(e, SnapshotAborted)
+            else "checkpoint.sweep"
+        )
         raise
 
-    # phase 2: the manifest commit — the cut's single atomic publish point
+    # phase 2: the manifest commit — the cut's single atomic publish
+    # point. The supervised boundary sits right before it: a host that
+    # dies/hangs HERE leaves the torn-2PC shape (shards landed, manifest
+    # never renamed) that `sweep_uncommitted` cancels on recovery.
+    from ..parallel import supervisor
+
+    supervisor.pulse_boundary(supervisor.PHASE_COMMIT)
     manifest = {
         "formatVersion": SHARDED_FORMAT_VERSION,
         "version": int(snapshot_version),
@@ -538,16 +591,37 @@ def gc_snapshots(
                 cut not in keep and cut < newest
             )
             if dead and name not in referenced:
-                os.remove(full)
-                removed += 1
+                removed += _remove_quiet(full)
         elif stable_re.match(name) and name not in referenced:
-            os.remove(full)
-            removed += 1
+            removed += _remove_quiet(full)
         elif name.startswith(base + ".stable-") and ".tmp" in name:
-            os.remove(full)
-            removed += 1
+            removed += _remove_quiet(full)
     if removed:
         metrics.inc_counter("checkpoint.gc", removed)
+    return removed
+
+
+def sweep_uncommitted(path: str, job_key: Optional[str]) -> int:
+    """Cancel the in-flight cut: delete every file of cuts NEWER than the
+    newest committed manifest, plus stale temps — the elastic
+    supervisor's abort path (`SnapshotAborted` semantics without the
+    exception: whatever the aborted attempt landed is removed and the
+    previous committed cut stays the restore target). Committed cuts and
+    stable shards referenced by manifests are never touched. Returns the
+    number of files removed (`checkpoint.sweep`)."""
+    if not os.path.isdir(path):
+        return 0
+    base = _base(job_key)
+    cuts = committed_cuts(path, job_key)
+    newest = cuts[-1] if cuts else 0
+    removed = 0
+    for name in sorted(os.listdir(path)):
+        cut = _cut_of(name, base)
+        dead = cut is not None and (cut > newest or ".tmp" in name)
+        if dead or (name.startswith(base + ".stable-") and ".tmp" in name):
+            removed += _remove_quiet(os.path.join(path, name))
+    if removed:
+        metrics.inc_counter("checkpoint.sweep", removed)
     return removed
 
 
@@ -563,8 +637,7 @@ def purge(path: str, job_key: Optional[str]) -> int:
     removed = 0
     for name in sorted(os.listdir(path)):
         if _cut_of(name, base) is not None or name.startswith(base + ".stable-"):
-            os.remove(os.path.join(path, name))
-            removed += 1
+            removed += _remove_quiet(os.path.join(path, name))
     return removed
 
 

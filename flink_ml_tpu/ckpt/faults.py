@@ -51,6 +51,18 @@ out of the loop at a controlled point. Two entry styles:
   |                   | BEFORE the pointer swap — the mid-publish kill    |
   |                   | the resume-republishes-same-version contract      |
   |                   | covers (docs/model_lifecycle.md)                  |
+  | `host.die`        | AT every supervised host-health boundary          |
+  |                   | (parallel/supervisor.py): the fired plan stops    |
+  |                   | the victim host's heartbeat sender — detection    |
+  |                   | rides the heartbeat timeout, recovery is the      |
+  |                   | supervisor's quarantine + shrink-and-resume       |
+  | `host.hang`       | same boundaries: the victim never enters this     |
+  |                   | one — the fit thread blocks like a wedged         |
+  |                   | collective until the supervisor's hang watchdog   |
+  |                   | aborts the attempt                                |
+  | `host.die.<phase>`| phase-targeted twins (`dispatch` = mid-epoch,     |
+  | `host.hang.<phase>`| `collective` = mid-drain, `commit` = mid-        |
+  |                   | snapshot-write) — the chaos-matrix axes           |
 
   Ticks fire AFTER the boundary's snapshot save, so an injected kill
   models a crash between a completed checkpoint and the next boundary —

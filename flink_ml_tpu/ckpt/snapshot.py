@@ -264,6 +264,12 @@ def save_job_snapshot(
         os.makedirs(path, exist_ok=True)
         target = snapshot_file(path, job_key)
 
+        # the supervised mid-commit boundary (parallel/supervisor.py):
+        # a host that dies/hangs here has not written anything yet — the
+        # abort path has nothing to sweep on the single-file path
+        from ..parallel import supervisor as _supervisor
+
+        _supervisor.pulse_boundary(_supervisor.PHASE_COMMIT)
         # transient write faults (flaky filesystem, faults.flaky plans)
         # re-run the WHOLE temp-write-then-rename sequence — safe because
         # nothing before the os.replace is observable to a reader; a fatal

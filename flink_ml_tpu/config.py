@@ -259,6 +259,13 @@ retry_max_delay_s: float = 0.25
 # A stage execution exceeding this multiple of its trailing-mean latency
 # is flagged by flow.StragglerWatchdog (`flow.straggler.*` counters).
 straggler_factor: float = 4.0
+# Watchdog ESCALATION (opt-in): after this many CONSECUTIVE flagged
+# samples on one stage, StragglerWatchdog raises a typed
+# `flow.PersistentStraggler` instead of only bumping counters — the
+# signal a supervisor can act on (quarantine, re-dispatch) where a
+# counter is only a breadcrumb. 0 = off (the counter-only default); a
+# healthy sample resets the streak, so a one-off blip never escalates.
+straggler_escalate: int = 0
 # Overload policy of the online-estimator ingest channel
 # (OnlineKMeans/OnlineLogisticRegression global-batch staging): "block" is
 # lossless credit-based backpressure — every batch is folded, results are
@@ -278,6 +285,18 @@ serving_admission: int = 16
 # deadline): a request whose deadline passes before dispatch is shed
 # (`serving.deadlineMiss`), one that finishes late is delivered marked late.
 serving_deadline_ms: Optional[float] = None
+
+
+@contextmanager
+def straggler_escalation_mode(consecutive: int):
+    """Scoped override of `straggler_escalate` (0 disables escalation)."""
+    global straggler_escalate
+    prev = straggler_escalate
+    straggler_escalate = max(0, int(consecutive))
+    try:
+        yield
+    finally:
+        straggler_escalate = prev
 
 
 @contextmanager
@@ -383,6 +402,54 @@ if os.environ.get("FLINK_ML_TPU_SNAPSHOT_HOST_DEADLINE_S"):
     snapshot_host_deadline_s = float(
         os.environ["FLINK_ML_TPU_SNAPSHOT_HOST_DEADLINE_S"]
     )
+
+
+# --- elastic training supervisor (parallel/supervisor.py) ---------------------
+# Hang-watchdog deadline multiplier: a supervised fit that makes no
+# dispatch/drain/commit progress for more than `hang_factor` times the
+# EMA of its chunk wall (flow.StragglerWatchdog's trailing mean, fed by
+# every `dispatch.timed_dispatch` / DrainQueue drain) is declared a
+# `CollectiveHang` — the survivors-blocked-in-a-collective failure mode
+# a counter can never surface.
+hang_factor: float = 8.0
+# Floor under the hang deadline (seconds): protects against a tiny EMA
+# (fast warm chunks) declaring a hang on ordinary scheduler jitter.
+hang_min_deadline_s: float = 1.0
+# A (simulated) host whose heartbeat is older than this is declared a
+# `HostFailure`. Heartbeats ride the supervisor's side channel (the DCN
+# heartbeat analogue), NOT the training loop, so a host that is alive
+# but stuck in a collective keeps beating — that case is the hang
+# watchdog's, which is why the two detectors are separate.
+host_heartbeat_timeout_s: float = 1.0
+# Supervisor monitor poll cadence (seconds): bounds detection latency
+# from below; heartbeat refresh and deadline checks run once per poll.
+supervisor_poll_interval_s: float = 0.02
+# Automatic recoveries (quarantine + mesh re-form + elastic restore +
+# resume) the supervisor may spend on one fit before giving up and
+# raising `RecoveryBudgetExhausted` carrying the typed failures.
+recovery_budget: int = 2
+
+
+@contextmanager
+def recovery_budget_mode(budget: int):
+    """Scoped override of `recovery_budget` (0 = detect but never resume)."""
+    global recovery_budget
+    prev = recovery_budget
+    recovery_budget = max(0, int(budget))
+    try:
+        yield
+    finally:
+        recovery_budget = prev
+
+
+if os.environ.get("FLINK_ML_TPU_RECOVERY_BUDGET"):
+    recovery_budget = max(0, int(os.environ["FLINK_ML_TPU_RECOVERY_BUDGET"]))
+if os.environ.get("FLINK_ML_TPU_HOST_HEARTBEAT_TIMEOUT_S"):
+    host_heartbeat_timeout_s = float(
+        os.environ["FLINK_ML_TPU_HOST_HEARTBEAT_TIMEOUT_S"]
+    )
+if os.environ.get("FLINK_ML_TPU_HANG_FACTOR"):
+    hang_factor = float(os.environ["FLINK_ML_TPU_HANG_FACTOR"])
 
 
 # --- model lifecycle: hot-swap, promotion gate, rollback (lifecycle.py) -------

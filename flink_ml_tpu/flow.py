@@ -98,6 +98,7 @@ __all__ = [
     "TRANSIENT_ERRORS",
     "with_retries",
     "StragglerWatchdog",
+    "PersistentStraggler",
 ]
 
 
@@ -478,6 +479,27 @@ def with_retries(
 # straggler watchdog
 # ---------------------------------------------------------------------------
 
+class PersistentStraggler(RuntimeError):
+    """The watchdog's typed escalation (opt-in via
+    `config.straggler_escalate` or the `escalate` ctor arg): one stage
+    was flagged on `consecutive` samples IN A ROW — no longer a blip the
+    EMA will absorb but a stage that has durably stopped keeping up, the
+    input a supervisor can act on (quarantine, re-dispatch, abort)
+    where a counter is only a breadcrumb. Carries the evidence so the
+    handler never parses a message string."""
+
+    def __init__(self, stage: str, consecutive: int, seconds: float, mean_s: float):
+        super().__init__(
+            f"stage {stage!r} straggled on {consecutive} consecutive samples "
+            f"(last {seconds * 1000.0:.1f}ms vs trailing mean "
+            f"{mean_s * 1000.0:.1f}ms)"
+        )
+        self.stage = stage
+        self.consecutive = consecutive
+        self.seconds = seconds
+        self.mean_s = mean_s
+
+
 class StragglerWatchdog:
     """Flag stage executions that exceed a multiple of the stage's
     trailing-mean latency.
@@ -487,7 +509,15 @@ class StragglerWatchdog:
     stragglers). A flagged sample increments `flow.straggler` and
     `flow.straggler.<stage>` and publishes the offending latency as the
     `flow.straggler.<stage>.lastMs` gauge — obs counters, not exceptions:
-    a straggler is a symptom to surface, not a failure to inject."""
+    a straggler is a symptom to surface, not a failure to inject.
+
+    Escalation (opt-in): with `escalate` set (ctor arg, falling back to
+    `config.straggler_escalate`; 0 = off), `record` raises a typed
+    `PersistentStraggler` once that many consecutive samples flag — the
+    counter stays a symptom, the streak becomes a failure. A healthy
+    sample resets the streak, and the escalating sample still folds into
+    the mean first, so a caller that catches and continues observes the
+    same trailing mean as a non-escalating watchdog."""
 
     def __init__(
         self,
@@ -495,13 +525,16 @@ class StragglerWatchdog:
         factor: Optional[float] = None,
         warmup: int = 5,
         alpha: float = 0.25,
+        escalate: Optional[int] = None,
     ):
         self.stage = stage
         self._factor = factor
         self.warmup = max(1, int(warmup))
         self.alpha = float(alpha)
+        self._escalate = escalate
         self._mean = 0.0
         self._n = 0
+        self._streak = 0  # consecutive flagged samples
 
     @property
     def factor(self) -> float:
@@ -512,8 +545,26 @@ class StragglerWatchdog:
         return config.straggler_factor
 
     @property
+    def escalate_after(self) -> int:
+        """Consecutive flags that raise `PersistentStraggler` (0 = never)."""
+        if self._escalate is not None:
+            return max(0, int(self._escalate))
+        from . import config
+
+        return max(0, int(config.straggler_escalate))
+
+    @property
     def trailing_mean_s(self) -> float:
         return self._mean
+
+    @property
+    def samples(self) -> int:
+        """Samples folded so far (warmup arming rides on this)."""
+        return self._n
+
+    @property
+    def consecutive_flags(self) -> int:
+        return self._streak
 
     @contextmanager
     def observe(self):
@@ -524,7 +575,9 @@ class StragglerWatchdog:
             self.record(time.perf_counter() - t0)
 
     def record(self, seconds: float) -> bool:
-        """Fold one latency sample; returns True when it was flagged."""
+        """Fold one latency sample; returns True when it was flagged.
+        Raises `PersistentStraggler` when escalation is armed and this
+        sample extends the consecutive-flag streak to the threshold."""
         flagged = (
             self._n >= self.warmup
             and self._mean > 0.0
@@ -536,10 +589,18 @@ class StragglerWatchdog:
             metrics.set_gauge(f"flow.straggler.{self.stage}.lastMs", seconds * 1000.0)
         # stragglers still fold into the mean: a stage that got
         # permanently slower stops being flagged once the mean catches up
+        mean_before = self._mean
         self._mean = (
             seconds
             if self._n == 0
             else (1.0 - self.alpha) * self._mean + self.alpha * seconds
         )
         self._n += 1
+        self._streak = self._streak + 1 if flagged else 0
+        threshold = self.escalate_after
+        if flagged and threshold and self._streak >= threshold:
+            metrics.inc_counter("flow.straggler.escalated")
+            metrics.inc_counter(f"flow.straggler.{self.stage}.escalated")
+            self._streak = 0  # a caller that catches and continues re-arms
+            raise PersistentStraggler(self.stage, threshold, seconds, mean_before)
         return flagged

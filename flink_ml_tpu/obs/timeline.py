@@ -80,6 +80,7 @@ __all__ = [
     "LANE_FLOW",
     "LANE_SERVING",
     "LANE_LIFECYCLE",
+    "LANE_SUPERVISOR",
 ]
 
 # Logical-stream lanes (host threads get their own "host:<name>" lanes).
@@ -91,6 +92,7 @@ LANE_COLLECTIVE = "collective"
 LANE_FLOW = "flow"
 LANE_SERVING = "serving"
 LANE_LIFECYCLE = "lifecycle"
+LANE_SUPERVISOR = "supervisor"
 
 #: Stable lane ordering for Chrome `tid` assignment: host lanes first,
 #: then the logical streams in pipeline order, then anything else.
@@ -103,6 +105,7 @@ _LANE_ORDER = (
     LANE_FLOW,
     LANE_SERVING,
     LANE_LIFECYCLE,
+    LANE_SUPERVISOR,
 )
 
 _ORIGIN_NS = time.perf_counter_ns()

@@ -236,11 +236,19 @@ def timed_dispatch(step: Callable, *args, start: int = None, end: int = None):
     backend this times the enqueue; on CPU, the synchronous execution —
     either way it is exactly the time the host thread was captive to the
     launch. `start`/`end` are the chunk's planned epoch range (drives the
-    per-epoch attribution)."""
+    per-epoch attribution). Under a supervised fit
+    (parallel/supervisor.py) every launch is also a host-health
+    boundary: the supervisor's `host.die`/`host.hang` fault sites tick
+    here (the mid-epoch chaos axis) and the launch duration feeds the
+    hang watchdog's chunk-wall EMA."""
+    from . import supervisor
+
+    supervisor.pulse_boundary(supervisor.PHASE_DISPATCH)
     t0 = time.perf_counter_ns()
     out = step(*args)
     dur_ns = time.perf_counter_ns() - t0
     metrics.record_time("iteration.dispatch", dur_ns / 1e9)
+    supervisor.note_progress(dur_ns / 1e9)
     if timeline.enabled():
         attrs = {}
         if start is not None:
@@ -303,7 +311,12 @@ class DrainQueue:
     def _drain_one(self) -> Tuple[InFlight, int, float]:
         import jax
 
+        from . import supervisor
+
         entry, pushed_ns = self._q.popleft()
+        # the blocking readback is where a wedged collective manifests —
+        # the supervised mid-collective boundary sits right before it
+        supervisor.pulse_boundary(supervisor.PHASE_COLLECTIVE)
         t0_ns = time.perf_counter_ns()
         t0 = time.perf_counter()
         host = np.asarray(jax.device_get(entry.packed))
@@ -311,8 +324,10 @@ class DrainQueue:
         tracing.account_readback(host.nbytes, time.perf_counter() - t0)
         end_ns = time.perf_counter_ns()
         # chunk wall: dispatch push -> drained scalar on host, the
-        # per-chunk latency distribution of the dispatch pipeline
+        # per-chunk latency distribution of the dispatch pipeline — and
+        # the hang watchdog's EMA sample under a supervised fit
         hist.record("iteration.chunkWallMs", (end_ns - pushed_ns) / 1e6)
+        supervisor.note_progress((end_ns - pushed_ns) / 1e9)
         if timeline.enabled():
             # estimated device-execution interval: dispatch end to the
             # blocking readback start (exact on a synchronous backend,
@@ -334,10 +349,14 @@ def drain_packed(packed) -> Tuple[int, float]:
     depth-1 / tail path), with the same accounting as DrainQueue."""
     import jax
 
+    from . import supervisor
+
+    supervisor.pulse_boundary(supervisor.PHASE_COLLECTIVE)
     t0 = time.perf_counter()
     host = np.asarray(jax.device_get(packed))
     tracing.account_host_sync("drain")
     tracing.account_readback(host.nbytes, time.perf_counter() - t0)
+    supervisor.note_progress(time.perf_counter() - t0)
     return int(host[0]), float(host[1])
 
 

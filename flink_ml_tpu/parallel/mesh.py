@@ -148,6 +148,17 @@ def host_groups(mesh: Mesh, num_hosts: int):
     return [list(g) for g in np.array_split(np.array(devices), num_hosts)]
 
 
+def form_mesh_over(groups: Sequence[Sequence], axis_names: Sequence[str] = (DATA_AXIS,)) -> Mesh:
+    """Re-form a mesh over the concatenation of the given host device
+    groups — the survivor mesh after the elastic supervisor
+    (parallel/supervisor.py) quarantines a failed host. Groups come from
+    `host_groups`; empty groups (surplus hosts) contribute nothing."""
+    devices = [d for g in groups for d in g]
+    if not devices:
+        raise ValueError("cannot form a mesh over zero surviving devices")
+    return create_mesh(axis_names, devices=devices)
+
+
 def shard_axis_for_tag(tag: str, ndim: int) -> Optional[int]:
     """The array axis a sharding-spec tag splits across hosts, or None for
     whole-array tags (`replicated` / `host`). Mirrors `data_sharding`

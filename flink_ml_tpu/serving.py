@@ -355,6 +355,7 @@ class MicroBatchServer:
             for batch in stream:
                 entry = self._dispatch(batch, num_batches)
                 if not window.offer(entry):  # window full: retire the oldest
+                    # tpulint: disable=untimed-wait -- single-threaded pull loop: offer() just returned False, so the window is non-empty and get() cannot block
                     yield self._finish(*window.get())
                     window.offer(entry)
                 num_batches += 1
@@ -362,6 +363,7 @@ class MicroBatchServer:
                 metrics.inc_counter("serving.records", entry[2])
                 metrics.set_gauge("serving.buckets", len(self._buckets_seen))
             while len(window):
+                # tpulint: disable=untimed-wait -- single-threaded pull loop: guarded by len(window) > 0, get() cannot block
                 yield self._finish(*window.get())
         finally:
             self._release(window)
@@ -481,9 +483,11 @@ class MicroBatchServer:
                     self._emit(ServeResult(seq, "error", error=e))
                     continue
                 if not window.offer((seq, deadline) + entry):
+                    # tpulint: disable=untimed-wait -- dispatch-worker-local window: offer() just returned False, so the window is non-empty and get() cannot block
                     self._retire(window.get())
                     window.offer((seq, deadline) + entry)
             while len(window):
+                # tpulint: disable=untimed-wait -- dispatch-worker-local window: guarded by len(window) > 0, get() cannot block
                 self._retire(window.get())
             self._out.close()
         except BaseException as e:  # worker death must not strand consumers

@@ -47,6 +47,10 @@ def test_metric_directions():
     assert bench_diff.metric_direction("epochMsAmortized") == "lower"
     assert bench_diff.metric_direction("hostSyncCount") == "lower"
     assert bench_diff.metric_direction("relDiff") == "lower"
+    # the elastic supervisor's SLO leaves (ISSUE 15): detection latency
+    # and recovery wall regress upward
+    assert bench_diff.metric_direction("elasticRecovery.detectionMs") == "lower"
+    assert bench_diff.metric_direction("elasticRecovery.recoveryWallMs") == "lower"
     assert bench_diff.metric_direction("inputThroughput") == "higher"
     assert bench_diff.metric_direction("trainedExamplesPerSec") == "higher"
     assert bench_diff.metric_direction("trainLoopMFU_trace") == "higher"

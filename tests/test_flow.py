@@ -336,6 +336,64 @@ class TestStragglerWatchdog:
         assert wd.trailing_mean_s >= 0.0
 
 
+class TestStragglerEscalation:
+    """Satellite (ISSUE 15): repeated flags on one stage can raise a
+    typed PersistentStraggler instead of only bumping counters — opt-in
+    via config.straggler_escalate or the ctor arg."""
+
+    def test_counter_only_by_default(self):
+        wd = flow.StragglerWatchdog("t.noesc", factor=2.0, warmup=2)
+        for _ in range(3):
+            wd.record(0.01)
+        for k in range(6):  # 6 consecutive flags, never an exception
+            # 3x the previous sample always clears factor x EMA
+            assert wd.record(0.03 * (3 ** k))
+        assert wd.consecutive_flags == 6
+
+    def test_consecutive_flags_escalate_with_evidence(self):
+        wd = flow.StragglerWatchdog("t.esc", factor=2.0, warmup=2, escalate=3)
+        before = metrics.get_counter("flow.straggler.t.esc.escalated", 0)
+        for _ in range(3):
+            wd.record(0.01)
+        assert wd.record(0.5)
+        assert wd.record(0.5)
+        with pytest.raises(flow.PersistentStraggler) as ei:
+            wd.record(0.5)
+        assert ei.value.stage == "t.esc"
+        assert ei.value.consecutive == 3
+        assert ei.value.seconds == pytest.approx(0.5)
+        assert ei.value.mean_s > 0.0
+        assert (
+            metrics.get_counter("flow.straggler.t.esc.escalated", 0) == before + 1
+        )
+        # a caller that catches and continues is re-armed, not dead
+        assert wd.consecutive_flags == 0
+
+    def test_healthy_sample_resets_the_streak(self):
+        wd = flow.StragglerWatchdog(
+            "t.reset", factor=3.0, warmup=2, alpha=0.05, escalate=3
+        )
+        for _ in range(4):
+            wd.record(0.01)
+        assert wd.record(0.1)
+        assert wd.record(0.1)
+        assert not wd.record(0.01)  # healthy: streak resets
+        assert wd.consecutive_flags == 0
+        assert wd.record(0.2)  # two flags again — still below threshold
+        assert wd.record(0.2)
+
+    def test_opt_in_via_config(self):
+        wd = flow.StragglerWatchdog("t.cfg", factor=2.0, warmup=2)
+        for _ in range(3):
+            wd.record(0.01)
+        with config.straggler_escalation_mode(2):
+            assert wd.escalate_after == 2
+            assert wd.record(0.5)
+            with pytest.raises(flow.PersistentStraggler):
+                wd.record(0.5)
+        assert wd.escalate_after == 0  # scoped override restored
+
+
 # ---------------------------------------------------------------------------
 # config surface
 # ---------------------------------------------------------------------------

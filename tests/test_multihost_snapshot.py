@@ -201,7 +201,9 @@ def test_model_tag_shards_trailing_axis(tmp_path):
 def test_kill_mid_shard_write_leaves_previous_cut_restorable(tmp_path):
     _save(tmp_path, epoch=1, scale=1.0)
     # host 2 (the third shard write) dies after its temp file, before its
-    # rename — no manifest ever commits, the cut is torn
+    # rename — no manifest ever commits, the cut is torn, and the
+    # exception-path sweep (ISSUE 15 satellite) removes its partials
+    # IMMEDIATELY instead of leaving orphans for the next commit's GC
     with faults.inject("snapshot.shard.write", after=3) as plan:
         with pytest.raises(InjectedFault):
             _save(tmp_path, epoch=2, scale=9.0)
@@ -211,15 +213,14 @@ def test_kill_mid_shard_write_leaves_previous_cut_restorable(tmp_path):
     np.testing.assert_array_equal(
         snap.sections["model"][0], np.arange(8, dtype=np.float32)
     )
-    # the writer recovers: the next commit succeeds and GC sweeps the
-    # torn cut's orphaned shard files
-    _save(tmp_path, epoch=2, scale=2.0)
     orphans = [
         n
         for n in os.listdir(tmp_path)
-        if coordinator._cut_of(n, "snap-j") == 2 and n.endswith(".npz")
+        if coordinator._cut_of(n, "snap-j") == 2
     ]
     assert orphans == []
+    # the writer recovers: the next commit succeeds
+    _save(tmp_path, epoch=2, scale=2.0)
     assert _load(tmp_path).epoch == 2
 
 
@@ -371,6 +372,126 @@ def test_straggler_deadline_bounds_the_wait(tmp_path):
         config.snapshot_host_deadline_s = prev
     assert plan.failures == 1  # one attempt, no retry spin
     assert _load(tmp_path).epoch == 1
+
+
+def test_unexpected_exception_mid_cut_sweeps_partials(tmp_path):
+    """Satellite (ISSUE 15): a NON-SnapshotAborted failure mid-cut — an
+    injected kill inside host 2's shard write — must sweep the partial
+    shard files immediately, not leave them for the next commit's GC."""
+    _save(tmp_path, epoch=1)
+    before = metrics.get_counter("checkpoint.sweep", 0)
+    with faults.inject("snapshot.shard.write", after=3):
+        with pytest.raises(InjectedFault):
+            _save(tmp_path, epoch=2, scale=9.0)
+    # hosts 0 and 1 landed their shards before the kill; host 2 left a
+    # temp — ALL of it is gone, and the previous cut is untouched
+    leftovers = [
+        n for n in os.listdir(tmp_path) if coordinator._cut_of(n, "snap-j") == 2
+    ]
+    assert leftovers == []
+    assert metrics.get_counter("checkpoint.sweep", 0) == before + 1
+    assert _load(tmp_path).epoch == 1
+
+
+def test_mid_commit_kill_keeps_torn_2pc_shape_and_sweep_cancels_it(tmp_path):
+    """A kill mid-MANIFEST-commit models a crash between the two phases:
+    the torn-2PC artifact (shards landed, no manifest) deliberately
+    survives the in-process sweep — it is what a real crash leaves — and
+    `sweep_uncommitted` (the supervisor's abort path) cancels it."""
+    _save(tmp_path, epoch=1)
+    with faults.inject("snapshot.commit"):
+        with pytest.raises(InjectedFault):
+            _save(tmp_path, epoch=2, scale=9.0)
+    assert os.path.exists(coordinator.shard_file(str(tmp_path), "j", 2, 0))
+    removed = coordinator.sweep_uncommitted(str(tmp_path), "j")
+    assert removed >= 4  # the torn cut's shards (+ the manifest temp)
+    leftovers = [
+        n for n in os.listdir(tmp_path) if coordinator._cut_of(n, "snap-j") == 2
+    ]
+    assert leftovers == []
+    assert _load(tmp_path).epoch == 1
+    # committed state is never touched: sweeping again removes nothing
+    assert coordinator.sweep_uncommitted(str(tmp_path), "j") == 0
+
+
+def test_sweep_uncommitted_spares_reused_stable_shards(tmp_path):
+    """Stable-section files referenced by a committed manifest survive
+    `sweep_uncommitted` (only cuts NEWER than the last commit die)."""
+    jnp = _jnp()
+    arrays = {"model": (jnp.arange(8.0),)}
+
+    def save(epoch):
+        return save_job_snapshot(
+            str(tmp_path), "j", arrays, epoch=epoch,
+            specs={"model": ("data",), "cache": "data"},
+            meta={"numBatches": 2},
+            hosts=2,
+            stable_sections={"cache": lambda: (np.arange(16.0),)},
+        )
+
+    save(1)
+    stable = coordinator.stable_shard_file(str(tmp_path), "j", "cache", 0)
+    assert os.path.exists(stable)
+    with faults.inject("snapshot.commit"):
+        with pytest.raises(InjectedFault):
+            save(2)
+    coordinator.sweep_uncommitted(str(tmp_path), "j")
+    assert os.path.exists(stable)  # referenced by the committed cut
+    snap = load_job_snapshot(
+        str(tmp_path), "j", templates={"model": (jnp.zeros(8),)}
+    )
+    assert snap.epoch == 1
+    np.testing.assert_array_equal(
+        np.asarray(snap.sections["cache"][0]), np.arange(16.0)
+    )
+
+
+def test_concurrent_straggler_abort_racing_retention_gc(tmp_path):
+    """Satellite (ISSUE 15): a straggler abort racing a retention GC
+    must leave the previous cut restorable — the abort sweeps ONLY its
+    own cut's files, GC only unretained ones, so neither can victimize
+    the last committed manifest regardless of interleaving."""
+    import threading
+
+    _save(tmp_path, epoch=1)
+    _save(tmp_path, epoch=2, scale=2.0)
+    stop = threading.Event()
+    errors = []
+
+    def gc_loop():
+        try:
+            while not stop.is_set():
+                coordinator.gc_snapshots(str(tmp_path), "j")
+        except BaseException as e:  # noqa: BLE001 — surfaced to the assert below
+            errors.append(e)
+
+    worker = threading.Thread(target=gc_loop, daemon=True)  # tpulint: disable=unbounded-queue -- test-local racer, joined below
+    worker.start()
+    try:
+        for k in range(4):
+            with config.transient_retry_mode(0):
+                with faults.flaky("snapshot.shard.write", times=99):
+                    with pytest.warns(UserWarning, match="aborted"):
+                        assert _save(tmp_path, epoch=3 + k, scale=9.0) is None
+    finally:
+        stop.set()
+        worker.join(timeout=10.0)
+    assert not worker.is_alive()
+    assert errors == []
+    snap = _load(tmp_path)
+    assert snap.epoch == 2  # the previous committed cut survived the race
+    np.testing.assert_array_equal(
+        snap.sections["model"][0], np.arange(8, dtype=np.float32) * 2.0
+    )
+    # and the directory holds no aborted-cut debris
+    cuts = coordinator.committed_cuts(str(tmp_path), "j")
+    stray = [
+        n
+        for n in os.listdir(tmp_path)
+        if (coordinator._cut_of(n, "snap-j") or 0) not in cuts
+        and coordinator._cut_of(n, "snap-j") is not None
+    ]
+    assert stray == []
 
 
 def test_transient_shard_write_retried_within_budget(tmp_path):

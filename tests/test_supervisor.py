@@ -1,0 +1,346 @@
+"""Elastic training supervisor (ISSUE 15): live host-failure detection,
+collective hang watchdog, automatic shrink-and-resume.
+
+The chaos matrix injects `host.die` (heartbeat sender stops — detection
+rides the heartbeat timeout) and `host.hang` (the fit thread wedges like
+a stuck collective — detection rides the dispatch-progress deadline) at
+each supervised boundary phase: mid-epoch (`dispatch`), mid-collective
+(`collective`) and mid-commit (`commit`). Every scenario must
+auto-recover within `config.recovery_budget`; a same-host-count resume
+(hang + readmit) must be BIT-IDENTICAL to the unkilled fit, a shrink
+resume allclose per the documented cross-count reduction-order caveat
+(docs/fault_tolerance.md "Failure domains and automatic recovery")."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import config
+from flink_ml_tpu.ckpt import coordinator, faults
+from flink_ml_tpu.ckpt.faults import InjectedFault
+from flink_ml_tpu.ops.losses import BINARY_LOGISTIC_LOSS
+from flink_ml_tpu.ops.optimizer import SGD
+from flink_ml_tpu.parallel import mesh as mesh_lib
+from flink_ml_tpu.parallel import supervisor
+from flink_ml_tpu.parallel.iteration import iterate_bounded
+from flink_ml_tpu.utils import metrics
+
+# crisp-but-robust detection knobs for the virtual substrate: heartbeat
+# death must be seen well before the hang deadline floor (1s default)
+FAST = dict(heartbeat_timeout_s=0.25, poll_interval_s=0.01, stall_safety_s=30.0)
+
+
+
+def _dense_problem(n=384, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ np.linspace(1, -1, d) > 0).astype(np.float32)
+    return X, y
+
+
+def _sgd_fit(X, y, ckpt, key="sup", max_iter=12):
+    def fit(mesh):
+        return SGD(
+            max_iter=max_iter, global_batch_size=96, tol=0.0,
+            checkpoint_dir=ckpt, checkpoint_key=key,
+        ).optimize(
+            np.zeros(X.shape[1], np.float32), X, y, None,
+            BINARY_LOGISTIC_LOSS, mesh=mesh,
+        )
+
+    return fit
+
+
+@pytest.fixture(scope="module")
+def problem():
+    return _dense_problem()
+
+
+@pytest.fixture(scope="module")
+def reference(problem, tmp_path_factory):
+    """The unkilled checkpointed fit — the parity target (checkpointed,
+    so the code path matches the supervised runs exactly)."""
+    X, y = problem
+    ref_dir = str(tmp_path_factory.mktemp("ref"))
+    coeff, _, epochs = _sgd_fit(X, y, ref_dir)(mesh_lib.default_mesh())
+    assert epochs == 12
+    return np.asarray(coeff)
+
+
+def _no_uncommitted(path, key):
+    cuts = coordinator.committed_cuts(path, key)
+    newest = cuts[-1] if cuts else 0
+    stray = [
+        n
+        for n in os.listdir(path)
+        if (coordinator._cut_of(n, coordinator._base(key)) or 0) > newest
+        or ".tmp" in n
+    ]
+    assert stray == [], f"in-flight cut not cancelled: {stray}"
+
+
+# ---------------------------------------------------------------------------
+# single-scenario behavior
+# ---------------------------------------------------------------------------
+
+class TestDetection:
+    def test_host_death_detected_quarantined_and_shrink_resumed(
+        self, problem, reference, tmp_path
+    ):
+        X, y = problem
+        d = str(tmp_path)
+        before = metrics.get_counter("supervisor.hostFailure", 0)
+        with config.snapshot_hosts_mode(4):
+            with faults.inject("host.die.dispatch", after=4):
+                res = supervisor.supervise(
+                    _sgd_fit(X, y, d), hosts=4,
+                    checkpoint_dir=d, job_key="sup", **FAST,
+                )
+        assert res.attempts == 2 and res.recoveries == 1
+        (ev,) = res.events
+        assert ev.kind == "hostFailure" and ev.phase == "dispatch"
+        assert ev.quarantined and res.hosts == 3
+        assert 0.0 < ev.detection_ms < 5000.0
+        assert ev.recovery_ms is not None and ev.recovery_ms < 30000.0
+        assert metrics.get_counter("supervisor.hostFailure", 0) == before + 1
+        coeff, _, epochs = res.value
+        assert epochs == 12
+        # cross-host-count resume: allclose per the reduction-order caveat
+        np.testing.assert_allclose(
+            np.asarray(coeff), reference, rtol=5e-4, atol=1e-6
+        )
+        _no_uncommitted(d, "sup")
+
+    def test_collective_hang_detected_readmit_resume_bit_identical(
+        self, problem, reference, tmp_path
+    ):
+        X, y = problem
+        d = str(tmp_path)
+        with config.snapshot_hosts_mode(4):
+            with faults.inject("host.hang.collective", after=4):
+                res = supervisor.supervise(
+                    _sgd_fit(X, y, d), hosts=4, checkpoint_dir=d,
+                    job_key="sup", heartbeat_timeout_s=10.0,
+                    poll_interval_s=0.01, stall_safety_s=30.0,
+                )
+        (ev,) = res.events
+        assert ev.kind == "collectiveHang" and ev.phase == "collective"
+        assert not ev.quarantined and res.hosts == 4  # readmitted: same count
+        coeff, _, epochs = res.value
+        assert epochs == 12
+        # SAME-host-count resume is bit-identical to the unkilled fit
+        np.testing.assert_array_equal(np.asarray(coeff), reference)
+        _no_uncommitted(d, "sup")
+
+    def test_recovery_budget_exhausted_raises_typed(self, problem, tmp_path):
+        X, y = problem
+        d = str(tmp_path)
+        with config.snapshot_hosts_mode(4):
+            with faults.inject("host.die", after=2):
+                with pytest.raises(supervisor.RecoveryBudgetExhausted) as ei:
+                    supervisor.supervise(
+                        _sgd_fit(X, y, d), hosts=4, checkpoint_dir=d,
+                        job_key="sup", recovery_budget=0, **FAST,
+                    )
+        assert isinstance(ei.value.__cause__, supervisor.HostFailure)
+        assert len(ei.value.events) == 1
+
+    def test_non_supervised_errors_propagate_untouched(self, tmp_path):
+        def bad_fit(mesh):
+            raise ValueError("data bug")
+
+        with pytest.raises(ValueError, match="data bug"):
+            supervisor.supervise(bad_fit, hosts=2, **FAST)
+        assert supervisor.active() is None
+
+    def test_injected_crash_at_other_sites_is_not_laundered(
+        self, problem, tmp_path
+    ):
+        """The supervisor recovers from HOST failures; an injected kill
+        at a checkpoint boundary models a crash and must propagate."""
+        X, y = problem
+        with faults.inject("chunk", after=2):
+            with pytest.raises(InjectedFault):
+                supervisor.supervise(
+                    _sgd_fit(X, y, str(tmp_path)), hosts=2, **FAST
+                )
+
+    def test_pulses_are_noops_outside_supervision(self):
+        supervisor.pulse_boundary(supervisor.PHASE_DISPATCH)
+        supervisor.pulse_boundary(supervisor.PHASE_COMMIT)
+        supervisor.note_progress(0.01)
+        assert supervisor.active() is None
+
+
+class TestBoard:
+    def test_form_mesh_over_survivors(self):
+        import jax
+
+        board = supervisor.HostBoard(mesh_lib.default_mesh(), 4)
+        assert board.live() == [0, 1, 2, 3]
+        board.quarantine(2)
+        m = board.form_mesh()
+        expected = [d for h, g in enumerate(
+            mesh_lib.host_groups(mesh_lib.default_mesh(), 4)
+        ) if h != 2 for d in g]
+        assert list(m.devices.flat) == expected
+        assert len(expected) == len(jax.devices()) * 3 // 4
+
+    def test_overdue_tracks_only_stopped_senders(self):
+        import time
+
+        board = supervisor.HostBoard(mesh_lib.default_mesh(), 3)
+        board.mark_dead(1, "dispatch")
+        time.sleep(0.02)
+        board.beat_live(time.monotonic())
+        assert board.overdue(time.monotonic(), 0.5) == []  # not yet
+        time.sleep(0.06)
+        board.beat_live(time.monotonic())
+        overdue = board.overdue(time.monotonic(), 0.05)
+        assert [h for h, _ in overdue] == [1]
+
+
+# ---------------------------------------------------------------------------
+# THE chaos soak: kill and hang, mid-epoch / mid-collective / mid-commit
+# ---------------------------------------------------------------------------
+
+class TestChaosSoak:
+    @pytest.mark.parametrize("phase", ["dispatch", "collective", "commit"])
+    @pytest.mark.parametrize("kind", ["die", "hang"])
+    def test_sgd_chaos_matrix(self, problem, reference, tmp_path, kind, phase):
+        """Every (failure kind x boundary phase) cell auto-recovers
+        within the budget, cancels the in-flight cut, and lands on the
+        reference coefficients — bit-identical when the host count is
+        unchanged (hang+readmit), allclose after a shrink (die)."""
+        X, y = problem
+        d = str(tmp_path)
+        site = f"host.{kind}.{phase}"
+        # commit boundaries pulse once per host per save: target host 1's
+        # shard write of the third save so partial files exist on abort
+        after = 6 if phase == "commit" else 4
+        kwargs = dict(FAST)
+        if kind == "hang":
+            kwargs["heartbeat_timeout_s"] = 10.0  # hang watchdog must win
+        with config.snapshot_hosts_mode(4):
+            with faults.inject(site, after=after) as plan:
+                res = supervisor.supervise(
+                    _sgd_fit(X, y, d), hosts=4,
+                    checkpoint_dir=d, job_key="sup", **kwargs,
+                )
+        assert plan.fired
+        assert res.recoveries == 1 and res.attempts == 2
+        (ev,) = res.events
+        assert ev.phase == phase
+        assert ev.kind == ("hostFailure" if kind == "die" else "collectiveHang")
+        assert 0.0 < ev.detection_ms < 10000.0
+        coeff, _, epochs = res.value
+        assert epochs == 12
+        if kind == "hang":
+            assert res.hosts == 4
+            np.testing.assert_array_equal(np.asarray(coeff), reference)
+        else:
+            assert res.hosts == 3 and ev.quarantined
+            np.testing.assert_allclose(
+                np.asarray(coeff), reference, rtol=5e-4, atol=1e-6
+            )
+        _no_uncommitted(d, "sup")
+
+    def test_stream_sgd_host_death_resumes(self, tmp_path):
+        """Out-of-core stream SGD under supervision: host death mid-fit,
+        shrink, resume — parity with the unkilled stream fit."""
+        X, y = _dense_problem(n=480, seed=3)
+
+        def chunks():
+            return iter(
+                [(X[i:i + 120], y[i:i + 120], None) for i in range(0, 480, 120)]
+            )
+
+        def make_fit(ckpt):
+            def fit(mesh):
+                return SGD(
+                    max_iter=8, global_batch_size=120, tol=0.0,
+                    checkpoint_dir=ckpt, checkpoint_key="sup-stream",
+                ).optimize_stream(None, chunks(), BINARY_LOGISTIC_LOSS, mesh=mesh)
+
+            return fit
+
+        expected, _, _, _ = make_fit(None)(mesh_lib.default_mesh())
+        d = str(tmp_path)
+        with config.snapshot_hosts_mode(4):
+            with faults.inject("host.die", after=6):
+                res = supervisor.supervise(
+                    make_fit(d), hosts=4, checkpoint_dir=d,
+                    job_key="sup-stream", **FAST,
+                )
+        assert res.recoveries == 1 and res.events[0].kind == "hostFailure"
+        coeff, _, epochs, _ = res.value
+        assert epochs == 8
+        np.testing.assert_allclose(
+            np.asarray(coeff), np.asarray(expected), rtol=5e-4, atol=1e-6
+        )
+
+    def test_kmeans_out_of_core_hang_resumes_bit_identical(self, tmp_path):
+        """Out-of-core KMeans under supervision: collective hang,
+        readmit, same-mesh resume bit-identical to the unkilled fit."""
+        from flink_ml_tpu.models.clustering.kmeans import KMeans
+        from flink_ml_tpu.table import StreamTable, Table
+
+        rng = np.random.RandomState(7)
+        X = np.concatenate([rng.randn(200, 4) + 3.0, rng.randn(200, 4) - 3.0])
+        rng.shuffle(X)
+
+        def stream():
+            return StreamTable.from_batches(
+                [Table({"features": X[i:i + 80]}) for i in range(0, 400, 80)]
+            )
+
+        def fit(mesh):
+            with mesh_lib.use_mesh(mesh):
+                return KMeans().set_k(3).set_seed(11).set_max_iter(6).fit(stream())
+
+        full = fit(mesh_lib.default_mesh())
+        d = str(tmp_path)
+        with config.iteration_checkpointing(d):
+            with faults.inject("host.hang", after=5):
+                res = supervisor.supervise(
+                    fit, hosts=4, checkpoint_dir=d,
+                    heartbeat_timeout_s=10.0, poll_interval_s=0.01,
+                    stall_safety_s=30.0,
+                )
+        assert res.recoveries == 1
+        assert res.events[0].kind == "collectiveHang"
+        np.testing.assert_array_equal(res.value.centroids, full.centroids)
+        np.testing.assert_array_equal(res.value.weights, full.weights)
+
+    def test_iterate_bounded_hang_resumes_bit_identical(self, tmp_path):
+        """The raw iteration runtime under supervision."""
+        import jax.numpy as jnp
+
+        def body(carry, epoch):
+            new = carry * 0.9 + 1.0
+            return new, jnp.max(jnp.abs(new - carry))
+
+        def make_fit(ckpt):
+            def fit(mesh):
+                return iterate_bounded(
+                    body, jnp.zeros(4), max_iter=10, tol=None,
+                    checkpoint_dir=ckpt, checkpoint_interval=2,
+                    chunk_size=2, job_key="sup-it",
+                )
+
+            return fit
+
+        ref = make_fit(None)(None)
+        d = str(tmp_path)
+        with faults.inject("host.hang", after=3):
+            res = supervisor.supervise(
+                make_fit(d), hosts=2, checkpoint_dir=d, job_key="sup-it",
+                heartbeat_timeout_s=10.0, poll_interval_s=0.01,
+                stall_safety_s=30.0,
+            )
+        assert res.recoveries == 1
+        assert res.value.num_epochs == ref.num_epochs == 10
+        np.testing.assert_array_equal(
+            np.asarray(res.value.carry), np.asarray(ref.carry)
+        )

@@ -2119,3 +2119,122 @@ class TestSnapshotCommit:
         }, ["snapshot-commit"])
         assert report.findings == []
         assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# untimed-wait
+# ---------------------------------------------------------------------------
+
+class TestUntimedWait:
+    def test_true_positive_wait_join_and_channel_get(self, tmp_path):
+        report = _run(tmp_path, {
+            "parallel/runner.py": """
+                import threading
+
+                from ..flow import BoundedChannel
+
+                def drive(items):
+                    done = threading.Event()
+                    ch = BoundedChannel(4)
+                    worker = threading.Thread(target=lambda: None)
+                    done.wait()
+                    worker.join()
+                    return ch.get()
+            """,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert len(report.findings) == 3
+        assert {f.data[0] for f in report.findings} == {"wait", "join", "get"}
+        assert all(f.rule == "untimed-wait" for f in report.findings)
+
+    def test_true_positive_queueish_name_without_constructor(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving2.py": """
+                def pull(window, results_queue):
+                    a = window.get()
+                    b = results_queue.get()
+                    return a, b
+            """,
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert len(report.findings) == 2
+
+    def test_true_negative_timeouts_strings_dicts_contextvars(self, tmp_path):
+        report = _run(tmp_path, {
+            "parallel/clean.py": """
+                import contextvars
+                import threading
+
+                _current = contextvars.ContextVar("cur", default=None)
+
+                def drive(parts, table, remaining):
+                    done = threading.Event()
+                    worker = threading.Thread(target=lambda: None)
+                    while not done.wait(0.1):
+                        pass
+                    worker.join(timeout=2.0)
+                    sep = ", ".join(parts)
+                    ctx = _current.get()
+                    return table.get("key"), sep, ctx
+            """,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert report.findings == []
+
+    def test_timeout_none_is_still_untimed(self, tmp_path):
+        report = _run(tmp_path, {
+            "parallel/nonewait.py": """
+                import threading
+
+                def drive():
+                    done = threading.Event()
+                    done.wait(timeout=None)
+            """,
+            "parallel/__init__.py": "",
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("wait",)
+
+    def test_flow_module_is_the_sanctioned_home(self, tmp_path):
+        report = _run(tmp_path, {
+            "flow.py": """
+                import threading
+
+                class Channel:
+                    def block(self):
+                        cv = threading.Condition()
+                        with cv:
+                            cv.wait()
+            """,
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert report.findings == []
+
+    def test_suppression_with_reason_and_stale_suppression(self, tmp_path):
+        report = _run(tmp_path, {
+            "serving3.py": """
+                from .flow import BoundedChannel
+
+                def pull(entry):
+                    window = BoundedChannel(2)
+                    if not window.offer(entry):
+                        # tpulint: disable=untimed-wait -- offer() returned False, so the window is non-empty and get() cannot block
+                        return window.get()
+                    return None
+            """,
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+        stale = _run(tmp_path, {
+            "serving4.py": """
+                def pull(window):
+                    # tpulint: disable=untimed-wait -- nothing here blocks
+                    return window.credits()
+            """,
+            **LAZYJIT_STUB,
+        }, ["untimed-wait"])
+        assert any(f.rule == "unused-suppression" for f in stale.findings)
