@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     flowcontrol,
     hostsync,
     lockorder,
+    memledger,
     meshaxis,
     precision,
     residentprogram,

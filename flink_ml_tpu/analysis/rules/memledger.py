@@ -1,0 +1,176 @@
+"""Residency gate: long-lived device arrays born outside the ledger.
+
+The upload-accounting rule keeps *flows* honest (every H2D byte rides the
+accounted stager); this rule extends the same funnel contract to *stocks*:
+a device array bound to a module-level name or a ``self.<attr>`` slot
+lives for the process / object lifetime, and if it was created by a raw
+device-array constructor (``jax.device_put``, ``jnp.zeros``, ...) instead
+of an accounted funnel, the HBM ledger (obs/memledger.py) never sees it —
+`hbm.live.*`, `peakHbmBytes`, budget admission and the OOM forensics all
+under-report by exactly that allocation. Function-local device arrays are
+out of scope (transients the GC reclaims with the frame); so is anything
+staged through `stage_to_device`/`stage_from_callback` (tracked when a
+category is declared) or explicitly `memledger.track`-ed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from ..engine import Finding, Rule, register
+from ..source import SourceModule, dotted_name
+from . import _astwalk
+from .accounting import TRANSFER_PRIMITIVES
+
+#: Array constructors on the jax.numpy namespace that allocate a fresh
+#: device-resident array (views/dtype helpers are not creators).
+NUMPY_CREATORS = frozenset(
+    {
+        "zeros",
+        "ones",
+        "full",
+        "empty",
+        "array",
+        "asarray",
+        "arange",
+        "linspace",
+        "eye",
+        "zeros_like",
+        "ones_like",
+        "full_like",
+    }
+)
+
+#: Call names that mean the binding IS ledgered (the accounted funnels
+#: and the explicit tracking API) — their presence anywhere in the RHS
+#: exempts the assignment.
+FUNNEL_CALLS = frozenset(
+    {"stage_to_device", "stage_from_callback", "track", "device_constants"}
+)
+
+_JAX_MODULES = {"jax"}
+_NUMPY_MODULES = {"jax.numpy", "jnp"}
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> dotted module for every import in the file."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _creator_call(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """The dotted creator name when `node` is a raw device-array
+    constructor call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name or "." not in name:
+        # bare call: resolve `from jax import device_put`-style imports
+        resolved = aliases.get(name or "")
+        if resolved and "." in resolved:
+            mod, leaf = resolved.rsplit(".", 1)
+            name = f"{mod}.{leaf}"
+        else:
+            return None
+    head, leaf = name.rsplit(".", 1)
+    head = aliases.get(head.split(".")[0], head.split(".")[0]) + (
+        "." + head.split(".", 1)[1] if "." in head else ""
+    )
+    if head in _NUMPY_MODULES and leaf in NUMPY_CREATORS:
+        return f"{head}.{leaf}"
+    if head in _JAX_MODULES and leaf in TRANSFER_PRIMITIVES:
+        return f"{head}.{leaf}"
+    return None
+
+
+def _rhs_exempt(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func)
+            if name and name.rsplit(".", 1)[-1] in FUNNEL_CALLS:
+                return True
+    return False
+
+
+@register
+class UnledgeredResidencyRule(Rule):
+    id = "unledgered-residency"
+    title = "long-lived device array created outside the accounted funnels"
+    rationale = (
+        "A device array bound to a module-level name or a self.<attr> slot "
+        "is resident for the process/object lifetime, but one born from a "
+        "raw constructor (jax.device_put, jnp.zeros, ...) never enters the "
+        "HBM ledger — hbm.live.* gauges, peakHbmBytes, budget admission "
+        "and the OOM forensic snapshot all under-report by that "
+        "allocation. Route long-lived uploads through "
+        "prefetch.stage_to_device(..., category=...) or ledger them with "
+        "memledger.track; function-local transients are out of scope."
+    )
+    example = "self._centroids = jnp.zeros((k, d))  # use stage_to_device + category"
+    scope = ("flink_ml_tpu",)
+    # the analysis package only talks ABOUT these calls; obs/ implements
+    # the ledger itself
+    exclude = ("flink_ml_tpu/analysis", "flink_ml_tpu/obs/memledger.py")
+
+    def check_module(
+        self, project, module: SourceModule
+    ) -> Iterable[Finding]:
+        tree = module.tree
+        if tree is None:
+            return
+        aliases = _import_aliases(tree)
+
+        def check_assign(stmt, binding: str) -> Iterable[Finding]:
+            value = getattr(stmt, "value", None)
+            if value is None or _rhs_exempt(value):
+                return
+            for sub in ast.walk(value):
+                creator = _creator_call(sub, aliases)
+                if creator is not None:
+                    yield Finding(
+                        path=module.path,
+                        line=stmt.lineno,
+                        rule=self.id,
+                        message=(
+                            f"{binding} binds a device array from raw "
+                            f"{creator}(...) — a long-lived residency the "
+                            "HBM ledger never sees (stage it with "
+                            "prefetch.stage_to_device(..., category=...) "
+                            "or memledger.track it)"
+                        ),
+                        data=(creator, binding),
+                    )
+                    return
+
+        # module-level bindings (import-time residency, lives forever)
+        for stmt in _astwalk.statements_in_order(tree.body):
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                yield from check_assign(stmt, "module-level name")
+
+        # self.<attr> bindings (object-lifetime residency)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    yield from check_assign(node, f"self.{target.attr}")
+                    break

@@ -226,9 +226,13 @@ class AlgoOperator(Stage):
         (model arrays, params) state. Model arrays are re-assigned (never
         mutated in place) across this codebase, so object identity of the
         `_constant_sources()` plus the params version — plus the explicit
-        `model_data_version` publication counter — is a sound cache key."""
-        import jax
+        `model_data_version` publication counter — is a sound cache key.
 
+        The upload rides the accounted staging funnel under the ledger's
+        `model` category: published model constants ARE the resident
+        model, so `hbm.live.model` and `residentModelBytes` follow
+        publication/invalidation exactly (a republish drops the old
+        constants' tree, whose tracked entries close on GC)."""
         token = (
             self.__dict__.get("_params_version", 0),
             self.model_data_version,
@@ -237,7 +241,9 @@ class AlgoOperator(Stage):
         cached = self.__dict__.get("_device_consts")
         if cached is not None and cached[0] == token:
             return cached[1]
-        consts = jax.tree_util.tree_map(jax.device_put, self._kernel_constants())
+        from .parallel import prefetch
+
+        consts = prefetch.stage_to_device(self._kernel_constants(), category="model")
         self.__dict__["_device_consts"] = (token, consts)
         return consts
 

@@ -26,7 +26,7 @@ import numpy as np
 from typing import Dict, List, Optional
 
 from ..api import AlgoOperator, Estimator, Model
-from ..obs import tracing
+from ..obs import memledger, tracing
 from ..table import Table
 from ..utils import metrics, read_write
 
@@ -81,6 +81,7 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
     tracing.install_jax_hooks()
     metrics_before = metrics.snapshot()
     timeline_start_us = timeline.now_us()
+    hbm_mark = memledger.mark_peak()
     phases: Dict[str, float] = {}
 
     @contextmanager
@@ -205,6 +206,15 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "shedCount": int(delta["counters"].get("flow.shed", 0)),
         "rejectCount": int(delta["counters"].get("flow.reject", 0)),
         "peakQueueDepth": int(delta["gauges"].get("flow.peakQueueDepth", 0)),
+        # device-memory evidence (obs/memledger.py): the peak ledgered
+        # HBM bytes this entry touched (watermark over the whole entry,
+        # datagen included) and the model constants still resident at
+        # entry end — a peakHbmBytes jump between BENCH files means a
+        # loop started holding more live at once (the regression the
+        # ROADMAP's 2D-mesh and HBM-paging work must not cause), a
+        # residentModelBytes jump means published models grew
+        "peakHbmBytes": int(memledger.peak_since(hbm_mark)),
+        "residentModelBytes": int(memledger.live_bytes("model")),
         # model-lifecycle evidence (lifecycle.py): live model versions this
         # entry published into a serving plan, promotions the gate refused,
         # and health-triggered rollbacks — a promoteRejected jump between

@@ -523,6 +523,7 @@ def stage_section(
     name: str,
     mesh=None,
     specs: Union[None, str, Sequence[str]] = None,
+    category: Optional[str] = "optimizer",
 ):
     """Stage a restored section's leaves onto `mesh` (default mesh when
     None) according to their sharding-spec tags — the elastic re-shard
@@ -530,7 +531,10 @@ def stage_section(
     of a DIFFERENT device count is the same accounted upload as restoring
     onto the original one, just against the new mesh's shardings. Leaves
     tagged `host` stay numpy. `specs` overrides the stored tags (a
-    resuming job that knows its layout wins over the manifest)."""
+    resuming job that knows its layout wins over the manifest).
+    `category` ledgers the restored residency (obs/memledger.py) — the
+    default `optimizer` fits the dominant caller (the training carry a
+    resumed fit re-stages); pass None for transient sections."""
     import jax
 
     from ..parallel import mesh as mesh_lib
@@ -548,7 +552,9 @@ def stage_section(
         leaf
         if tag == "host"
         else h2d.stage_to_device(
-            np.asarray(leaf), _sharding_for(tag, mesh, np.ndim(leaf))
+            np.asarray(leaf),
+            _sharding_for(tag, mesh, np.ndim(leaf)),
+            category=category,
         )
         for leaf, tag in zip(leaves, tags)
     ]

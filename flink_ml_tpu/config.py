@@ -244,6 +244,36 @@ if os.environ.get("FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"):
     input_prefetch_depth = int(os.environ["FLINK_ML_TPU_INPUT_PREFETCH_DEPTH"])
 
 
+# --- HBM budget admission (obs/memledger.py) ----------------------------------
+# Device-memory admission budget over the ledger's live bytes: every
+# accounted staging funnel pre-checks "would this upload push ledgered
+# residency past the budget?" and raises a typed
+# `memledger.HbmBudgetExceeded` (carrying the per-category breakdown)
+# BEFORE the allocating dispatch — so OOM paths are exercised
+# deterministically on the CPU tier-1 mesh, and a budgeted production run
+# fails with attribution instead of an opaque RESOURCE_EXHAUSTED. None =
+# off (no admission check). Admission only raises or passes — it never
+# changes what a surviving fit computes, so a loose budget is
+# bit-identical to no budget.
+hbm_budget_bytes: Optional[int] = None
+
+
+@contextmanager
+def hbm_budget_mode(budget_bytes: Optional[int]):
+    """Scoped override of `hbm_budget_bytes` (None = admission off)."""
+    global hbm_budget_bytes
+    prev = hbm_budget_bytes
+    hbm_budget_bytes = None if budget_bytes is None else max(0, int(budget_bytes))
+    try:
+        yield
+    finally:
+        hbm_budget_bytes = prev
+
+
+if os.environ.get("FLINK_ML_TPU_HBM_BUDGET_BYTES"):
+    hbm_budget_bytes = max(0, int(os.environ["FLINK_ML_TPU_HBM_BUDGET_BYTES"]))
+
+
 # --- flow control + transient-fault resilience (flow.py) ---------------------
 # Retry budget for transiently-failing I/O sites (snapshot write/read,
 # DataCache spill reads, serving batch execution): extra attempts after the

@@ -44,10 +44,12 @@ by tests/test_input_pipeline.py across budgets {0, tiny, unbounded}.
 
 from __future__ import annotations
 
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, Optional
 
 from .. import flow
+from ..obs import memledger
 from ..utils import metrics
 
 __all__ = [
@@ -123,8 +125,21 @@ def restore_cache_contents(snap, cache):
     return segs
 
 
+def _release_ledger_entries(entries) -> None:
+    for item in entries.values():
+        memledger.release(item[2])
+    entries.clear()
+
+
 class DeviceEpochCache:
-    """Keyed LRU of device-resident batch pytrees under an HBM budget."""
+    """Keyed LRU of device-resident batch pytrees under an HBM budget.
+
+    Residency is ownership-accounted in the HBM ledger
+    (obs/memledger.py): every insert opens a `batchCache` entry, every
+    evict/replace/clear closes it, so the ledger's `batchCache` live
+    bytes and this cache's `devicecache.bytes` gauge are equal after ANY
+    hit/miss/evict sequence — `check_ledger_parity` pins the invariant
+    (tests/test_memledger.py runs it after adversarial sequences)."""
 
     def __init__(self, budget_bytes=_UNSET):
         if budget_bytes is _UNSET:
@@ -134,8 +149,13 @@ class DeviceEpochCache:
         self.budget_bytes: Optional[int] = (
             None if budget_bytes is None else max(0, int(budget_bytes))
         )
-        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()  # key -> (tree, nbytes)
+        # key -> (tree, nbytes, ledger handle)
+        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
         self._used = 0
+        # a cache dropped without clear() (a fit abandoning its loader)
+        # must not strand its ledger entries: the finalizer closes any
+        # still open when the cache object itself is collected
+        weakref.finalize(self, _release_ledger_entries, self._entries)
 
     @property
     def enabled(self) -> bool:
@@ -162,20 +182,39 @@ class DeviceEpochCache:
         old = self._entries.pop(key, None)
         if old is not None:
             self._used -= old[1]
-        self._entries[key] = (tree, nbytes)
+            memledger.release(old[2])
+            # a replaced entry's bytes left residency exactly as an
+            # evicted entry's do — count them, or gauge+evictBytes
+            # under-reports the bytes that ever left the cache
+            metrics.inc_counter("devicecache.replaceBytes", old[1])
+        handle = memledger.register("batchCache", nbytes)
+        self._entries[key] = (tree, nbytes, handle)
         self._used += nbytes
         while self.budget_bytes is not None and self._used > self.budget_bytes:
-            _, (_, evicted) = self._entries.popitem(last=False)
+            _, (_, evicted, ev_handle) = self._entries.popitem(last=False)
             self._used -= evicted
+            memledger.release(ev_handle)
             metrics.inc_counter("devicecache.evict")
             metrics.inc_counter("devicecache.evictBytes", evicted)
         metrics.set_gauge("devicecache.bytes", self._used)
         return True
 
     def clear(self) -> None:
+        for _, _, handle in self._entries.values():
+            memledger.release(handle)
         self._entries.clear()
         self._used = 0
         metrics.set_gauge("devicecache.bytes", 0)
+
+    def check_ledger_parity(self) -> None:
+        """Assert ledger `batchCache` live bytes == this cache's own
+        accounting (raises AssertionError naming both sides). Exact only
+        while this is the sole live DeviceEpochCache — the ledger
+        category is process-wide."""
+        ledgered = memledger.live_bytes("batchCache")
+        assert ledgered == self._used, (
+            f"ledger batchCache={ledgered} != devicecache bytes={self._used}"
+        )
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -112,10 +112,10 @@ class LogisticRegressionModel(Model, LogisticRegressionModelParams):
         from ...table import SparseBatch
 
         def _coeff(device_in: bool):
-            # memoized device-resident coefficient on the device path
-            if device_in:
-                return self.device_constants()["coefficient"]
-            return jnp.asarray(self.coefficient, jnp.float32)
+            # both input paths share the memoized publication upload
+            # (the ledgered `model` funnel) instead of a fresh
+            # unaccounted jnp.asarray upload per host-input call
+            return self.device_constants()["coefficient"]
 
         if isinstance(col, SparseBatch):  # wide sparse: never densify
             device_in = isinstance(col.indices, jax.Array)

@@ -153,11 +153,10 @@ class KMeansModel(Model, KMeansModelParams):
     def transform(self, *inputs: Table) -> List[Table]:
         (table,) = inputs
         X = as_dense_matrix(table.column(self.get_features_col()), allow_device=True)
-        centroids = (
-            self.device_constants()["centroids"]  # memoized upload
-            if isinstance(X, jax.Array)
-            else jnp.asarray(self.centroids, jnp.float32)
-        )
+        # both input paths share the memoized publication upload, so the
+        # centroids ride the ledgered `model` funnel exactly once per
+        # model state instead of a fresh unaccounted upload per call
+        centroids = self.device_constants()["centroids"]
         assign = jit_find_closest(self.get_distance_measure())(
             jnp.asarray(X, jnp.float32), centroids
         )

@@ -92,11 +92,10 @@ class LinearRegressionModel(Model, LinearRegressionModelParams):
         col = table.column(self.get_features_col())
         from .. import _linear
 
-        coeff = (
-            self.device_constants()["coefficient"]  # memoized upload
-            if _linear.is_device_column(col)
-            else jnp.asarray(self.coefficient, jnp.float32)
-        )
+        # both input paths share the memoized publication upload (the
+        # ledgered `model` funnel) instead of a fresh unaccounted
+        # jnp.asarray upload per host-input call
+        coeff = self.device_constants()["coefficient"]
         pred = _linear.raw_scores(col, coeff)
         # device in -> device out (the LR/SVC convention): materializing
         # here would pull the whole prediction vector through the tunnel

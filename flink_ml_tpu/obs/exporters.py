@@ -159,6 +159,8 @@ BENCH_FIELDS = (
     "shedCount",
     "rejectCount",
     "peakQueueDepth",
+    "peakHbmBytes",
+    "residentModelBytes",
     "swapCount",
     "rollbackCount",
     "promoteRejected",

@@ -64,6 +64,7 @@ __all__ = [
     "record_end",
     "record_complete",
     "record_instant",
+    "record_counter",
     "drain",
     "snapshot_events",
     "host_lane",
@@ -81,6 +82,7 @@ __all__ = [
     "LANE_SERVING",
     "LANE_LIFECYCLE",
     "LANE_SUPERVISOR",
+    "LANE_MEMORY",
 ]
 
 # Logical-stream lanes (host threads get their own "host:<name>" lanes).
@@ -93,6 +95,7 @@ LANE_FLOW = "flow"
 LANE_SERVING = "serving"
 LANE_LIFECYCLE = "lifecycle"
 LANE_SUPERVISOR = "supervisor"
+LANE_MEMORY = "memory"
 
 #: Stable lane ordering for Chrome `tid` assignment: host lanes first,
 #: then the logical streams in pipeline order, then anything else.
@@ -106,6 +109,7 @@ _LANE_ORDER = (
     LANE_SERVING,
     LANE_LIFECYCLE,
     LANE_SUPERVISOR,
+    LANE_MEMORY,
 )
 
 _ORIGIN_NS = time.perf_counter_ns()
@@ -236,6 +240,17 @@ def record_instant(lane: str, name: str, **args) -> None:
         ring.append(("i", lane, name, time.perf_counter_ns(), 0, None, args or None))
 
 
+def record_counter(lane: str, name: str, **series) -> None:
+    """One sample of a set of named counter series (Chrome `C` events —
+    Perfetto renders them as a stacked track). The HBM ledger samples
+    per-category live bytes onto the `memory` lane on every change."""
+    ring = _ring
+    if ring is not None:
+        ring.append(
+            ("C", lane, name, time.perf_counter_ns(), 0, None, series or None)
+        )
+
+
 def _event_dict(ev: Tuple) -> Dict:
     ph, lane, name, ts_ns, dur_ns, ref, args = ev
     out: Dict[str, Any] = {
@@ -313,7 +328,7 @@ def _resolve(events: Iterable[Dict]) -> Tuple[List[Dict], int]:
                     "args": ev.get("args"),
                 }
             )
-        elif ph in ("X", "i"):
+        elif ph in ("X", "i", "C"):
             resolved.append(ev)
     dropped += len(open_by_ref) + sum(len(s) for s in open_stack.values())
     resolved.sort(key=lambda e: e["tsUs"])
@@ -367,7 +382,7 @@ def to_chrome(events: Optional[Iterable[Dict]] = None) -> Dict:
         )
     for ev in resolved:
         rec: Dict[str, Any] = {
-            "ph": "X" if ev["ph"] == "X" else "i",
+            "ph": ev["ph"] if ev["ph"] in ("X", "C") else "i",
             "pid": 1,
             "tid": tids[ev["lane"]],
             "name": ev["name"],
@@ -375,7 +390,7 @@ def to_chrome(events: Optional[Iterable[Dict]] = None) -> Dict:
         }
         if ev["ph"] == "X":
             rec["dur"] = ev.get("durUs", 0.0)
-        else:
+        elif ev["ph"] != "C":
             rec["s"] = "t"  # instant scoped to its thread/lane
         if ev.get("args"):
             rec["args"] = _json_safe(ev["args"])

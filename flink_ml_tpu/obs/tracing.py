@@ -373,8 +373,18 @@ def install_jax_hooks() -> bool:
 def _wrap_stage_method(fn, op: str):
     import functools
 
+    from . import memledger
+
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
+        if op == "fit":
+            # per-fit HBM watermark (hbm.peak.fit) — always on, like the
+            # metrics registry: two dict ops per fit, no sink required
+            with memledger.fit_peak_scope():
+                if not _enabled:
+                    return fn(self, *args, **kwargs)
+                with Span("stage." + op, {"stage": type(self).__name__}):
+                    return fn(self, *args, **kwargs)
         if not _enabled:
             return fn(self, *args, **kwargs)
         with Span("stage." + op, {"stage": type(self).__name__}):

@@ -637,7 +637,9 @@ class SGD:
         X_b, y_b, w_b = self._batchify(mesh, X, y, weights, d_pad)
         init = np.asarray(init_coeff, self.dtype)
         if self.shard_features:
-            init = h2d.stage_to_device(init, mesh_lib.model_sharding(mesh))
+            init = h2d.stage_to_device(
+                init, mesh_lib.model_sharding(mesh), category="optimizer"
+            )
         if self.checkpoint_dir is not None:
             coeff, criteria, epochs = self._optimize_with_checkpoints(
                 X_b, y_b, w_b, init, loss_func, mesh
@@ -993,7 +995,9 @@ class SGD:
         stacked = np.empty((nb, b_pad, d + 2), np.dtype(self.dtype))
         for k, seg in enumerate(segs):
             stacked[k] = cache.read_array(seg)
-        packed_all = h2d.stage_to_device(stacked, stacked_sharding)
+        packed_all = h2d.stage_to_device(
+            stacked, stacked_sharding, category="streamSegments"
+        )
         dispatch.account_whole_fit("stream")
         with tracing.span(
             "iteration.run", mode="whole_fit", epochs=self.max_iter
@@ -1090,6 +1094,11 @@ class SGD:
         has_weights = w_f is not None
         if not has_weights:
             w_f = jnp.zeros((0,), self.dtype)
+        # the flat staged (or padded) arrays are this fit's training-data
+        # residency — ledger them like the batched layouts in _batchify
+        from ..obs import memledger
+
+        memledger.track((X_f, y_f, w_f), "streamSegments")
         from ..parallel import dispatch
 
         return dispatch.timed_dispatch(
@@ -1369,4 +1378,11 @@ class SGD:
             w_b = _default_weights(n, num_batches, B, b_pad, self.dtype, row_sharding)
         else:
             w_b = layout(stage(weights), n, num_batches, B, b_pad, None, row_sharding)
+        # the batched layouts are the fit-long training-data residency
+        # (the staged flat uploads above are donated into them); ledger
+        # them so hbm.live.streamSegments / peakHbmBytes see the fit's
+        # dominant allocation — entries close when the fit drops them
+        from ..obs import memledger
+
+        memledger.track((X_b, y_b, w_b), "streamSegments")
         return X_b, y_b, w_b

@@ -245,7 +245,18 @@ def timed_dispatch(step: Callable, *args, start: int = None, end: int = None):
 
     supervisor.pulse_boundary(supervisor.PHASE_DISPATCH)
     t0 = time.perf_counter_ns()
-    out = step(*args)
+    try:
+        out = step(*args)
+    except Exception as e:
+        # a backend RESOURCE_EXHAUSTED surfacing from the launch becomes
+        # the typed HbmExhausted carrying the ranked ledger snapshot —
+        # the OOM names who holds the memory, not just that it ran out
+        from ..obs import memledger
+
+        wrapped = memledger.wrap_oom(e)
+        if wrapped is not None:
+            raise wrapped from e
+        raise
     dur_ns = time.perf_counter_ns() - t0
     metrics.record_time("iteration.dispatch", dur_ns / 1e9)
     supervisor.note_progress(dur_ns / 1e9)

@@ -46,7 +46,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from .. import flow
-from ..obs import timeline
+from ..obs import memledger, timeline
 from ..utils import metrics
 
 __all__ = [
@@ -99,39 +99,71 @@ def account_h2d(nbytes: int, arrays: int = 1, seconds: Optional[float] = None) -
         )
 
 
-def stage_to_device(tree, sharding=None):
+def stage_to_device(tree, sharding=None, category: Optional[str] = None):
     """Accounted `jax.device_put`: upload a host array (or pytree of
     arrays; dtypes canonicalize exactly as `device_put` does) and count
     the host bytes moved. The one H2D funnel `models/` and `ops/` are
-    allowed to call (see `scripts/check_upload_accounting.py`)."""
+    allowed to call (see `scripts/check_upload_accounting.py`).
+
+    Every call is budget-admitted against `config.hbm_budget_bytes`
+    (typed `HbmBudgetExceeded` BEFORE the allocating dispatch) and a
+    real backend OOM is re-raised as `HbmExhausted` with the ranked
+    ledger snapshot. `category` additionally ledgers the staged arrays'
+    *residency* (obs/memledger.py) — declare it for long-lived uploads
+    (model constants, the optimizer carry, stacked whole-fit segments,
+    serving batches); leave it None for transients and for batches the
+    DeviceEpochCache will own (the cache does its own exact
+    register/release accounting, so a category here would double
+    count)."""
     import time
 
     import jax
 
     nbytes = _host_nbytes(tree)
+    memledger.admit(nbytes, category)
     t0 = time.perf_counter()
-    if sharding is not None:
-        out = jax.device_put(tree, sharding)
-    else:
-        out = jax.device_put(tree)
+    try:
+        if sharding is not None:
+            out = jax.device_put(tree, sharding)
+        else:
+            out = jax.device_put(tree)
+    except Exception as e:
+        wrapped = memledger.wrap_oom(e)
+        if wrapped is not None:
+            raise wrapped from e
+        raise
     if nbytes:
         account_h2d(nbytes, seconds=time.perf_counter() - t0)
+    if category is not None:
+        memledger.track(out, category)
     return out
 
 
-def stage_from_callback(shape, sharding, data_callback):
+def stage_from_callback(shape, sharding, data_callback, category: Optional[str] = None):
     """Accounted `jax.make_array_from_callback` (the per-shard zero-copy
     staging path of `_batchify`); bytes are counted from the staged
-    array's own dtype, so callers need not precompute it."""
+    array's own dtype, so callers need not precompute it. Budget
+    admission, OOM wrapping and optional residency tracking exactly as
+    `stage_to_device` (the byte estimate for admission uses the shape's
+    float32 size when the dtype is only known post-staging)."""
     import time
 
     import jax
 
+    memledger.admit(int(np.prod(shape)) * 4, category)
     t0 = time.perf_counter()
-    out = jax.make_array_from_callback(tuple(shape), sharding, data_callback)
+    try:
+        out = jax.make_array_from_callback(tuple(shape), sharding, data_callback)
+    except Exception as e:
+        wrapped = memledger.wrap_oom(e)
+        if wrapped is not None:
+            raise wrapped from e
+        raise
     account_h2d(
         int(np.prod(shape)) * out.dtype.itemsize, seconds=time.perf_counter() - t0
     )
+    if category is not None:
+        memledger.track(out, category)
     return out
 
 

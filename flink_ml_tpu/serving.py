@@ -69,7 +69,7 @@ import numpy as np
 
 from . import config, flow
 from .ckpt import faults
-from .obs import hist, timeline, tracing
+from .obs import hist, memledger, timeline, tracing
 from .parallel.prefetch import next_bucket, pad_rows, slice_rows, stage_to_device
 from .pipeline import PipelineModel, _drain_guards
 from .table import SparseBatch, Table
@@ -129,6 +129,12 @@ class ServerHealth:
     bucketsSeen: int
     emaBatchMs: float  # dispatch trailing-mean latency (watchdog EMA)
     stragglers: int  # dispatches flagged beyond straggler_factor x mean
+    # HBM ledger view (obs/memledger.py): total ledgered device-resident
+    # bytes and the global peak watermark at snapshot time — memory sits
+    # on the SLO surface next to the stage latencies, because the paging
+    # work (ROADMAP item 3) is graded against exactly these numbers
+    hbmLiveBytes: int = 0
+    hbmPeakBytes: int = 0
     # per-stage latency percentiles from obs/hist.py (p50/p90/p99/p999 +
     # count per stage: queueWait, batchForm, dispatch, readback,
     # deadlineMargin) — the SLO surface; empty until samples exist or
@@ -238,7 +244,10 @@ class MicroBatchServer:
             from .table import register_device_pytrees
 
             register_device_pytrees()  # SparseBatch uploads as a pytree
-            uploads = stage_to_device(uploads)  # accounted: h2d.bytes/count
+            # accounted (h2d.bytes/count) + ledgered: the in-flight window
+            # holds these buffers until the batch retires, so `serving`
+            # residency tracks the window depth live
+            uploads = stage_to_device(uploads, category="serving")
         return Table(
             {name: uploads.get(name, cols.get(name)) for name in batch.column_names}
         ), n
@@ -452,6 +461,8 @@ class MicroBatchServer:
             bucketsSeen=len(self._buckets_seen),
             emaBatchMs=self.watchdog.trailing_mean_s * 1000.0,
             stragglers=metrics.get_counter("flow.straggler.serving.batch", 0),
+            hbmLiveBytes=memledger.live_bytes(),
+            hbmPeakBytes=memledger.peak_bytes(),
             stageLatencyMs=stage_latency,
         )
 

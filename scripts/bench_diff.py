@@ -68,6 +68,11 @@ _LOWER_BETTER = (
     # hostSyncCount (docs/performance.md "Whole-fit resident programs")
     "dispatchcount",
     "wholefitfallbacks",
+    # device-memory watermarks (obs/memledger.py): an entry holding more
+    # HBM live at once, or a fatter resident model, gates exactly like a
+    # dispatch-count regression (docs/observability.md "Device memory")
+    "peakhbmbytes",
+    "residentmodelbytes",
 )
 _HIGHER_BETTER = (
     "throughput",

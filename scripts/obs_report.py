@@ -3,12 +3,20 @@
 
 Usage:
     python scripts/obs_report.py TRACE.jsonl [options]
+    python scripts/obs_report.py --hbm-dump DUMP.json
 
 Options:
     --device-profile PATH   Cross-reference a jax.profiler trace (a
                             profiler log dir or a *.trace.json.gz file)
                             via traceprof.analyze_trace — device-busy time
                             vs the host-side span accounting.
+    --hbm-dump PATH         Render an HBM forensic dump (the JSON an
+                            `HbmExhausted` writes when
+                            FLINK_ML_TPU_HBM_DUMP is set, or any
+                            memledger.dump_snapshot output): per-category
+                            live bytes, peak watermark, and the ranked
+                            entry table with allocation sites. Works
+                            standalone (no trace file) or alongside one.
     --max-epochs N          Rows to print in the epoch table (default 20;
                             the TOTAL row always aggregates all epochs).
     --format text|json      Output format (default text). JSON emits the
@@ -41,10 +49,53 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from flink_ml_tpu.obs import report  # noqa: E402
 
 
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} {unit}"
+        n /= 1024.0
+
+
+def render_hbm_dump(dump):
+    """The forensic ledger snapshot (memledger.snapshot shape) as the
+    ranked text table the OOM postmortem starts from."""
+    lines = [
+        f"HBM ledger: {_fmt_bytes(dump.get('liveBytes', 0))} live across "
+        f"{dump.get('entryCount', 0)} entr(ies), "
+        f"peak {_fmt_bytes(dump.get('peakBytes', 0))}",
+        "",
+        "  by category:",
+    ]
+    categories = dump.get("categories") or {}
+    for cat, nbytes in categories.items():
+        lines.append(f"    {cat:<16} {_fmt_bytes(nbytes):>12}")
+    if not categories:
+        lines.append("    (none live)")
+    entries = dump.get("topEntries") or []
+    if entries:
+        lines += ["", f"  top {len(entries)} entries by bytes:"]
+        for e in entries:
+            shape = "x".join(str(d) for d in e["shape"]) if e.get("shape") else "?"
+            lines.append(
+                f"    {_fmt_bytes(e.get('nbytes', 0)):>12}  "
+                f"{e.get('category', '?'):<14} {shape:<14} "
+                f"{e.get('dtype') or '?':<10} {e.get('site') or '?'}"
+            )
+    return "\n".join(lines)
+
+
 def main(argv):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
+    if "--hbm-dump" in argv:
+        from flink_ml_tpu.obs import memledger
+
+        dump_path = argv[argv.index("--hbm-dump") + 1]
+        print(render_hbm_dump(memledger.load_dump(dump_path)))
+        if argv[0] == "--hbm-dump":  # standalone mode, no trace to render
+            return 0
+        print()
     trace_path = argv[0]
     max_epochs = 20
     if "--max-epochs" in argv:

@@ -12,7 +12,15 @@ event JSONL (FLINK_ML_TPU_TIMELINE_FILE wins for the first if set):
 
 CI renders both with scripts/obs_timeline.py and uploads them as the
 per-run Perfetto artifacts (docs/observability.md), so the one-dispatch
-claim is visually checkable on every run.
+claim is visually checkable on every run. The chunked timeline carries
+the `memory` counter lane (hbm.live per category) — the HBM track in
+Perfetto.
+
+After the fits, a third probe re-runs the chunked fit under a deliberately
+tiny HBM budget (config.hbm_budget_mode) and asserts it fails with the
+*typed* HbmBudgetExceeded carrying a category breakdown — budget
+admission stays deterministic and clean (no raw RESOURCE_EXHAUSTED, no
+partial dispatch) on every CI run.
 
 Usage: python scripts/smoke_fit_timeline.py [EVENTS_OUT.jsonl]
 """
@@ -59,6 +67,29 @@ def _fit(timeline, config, out_path, mode, checkpoint_interval, label):
     return attr
 
 
+def _budget_probe(config):
+    """Re-run the chunked fit under a ~1 KiB HBM budget and demand the
+    typed, breakdown-carrying HbmBudgetExceeded — never a raw allocator
+    error or a silent success."""
+    from flink_ml_tpu.obs import memledger, timeline
+
+    with config.hbm_budget_mode(1024):
+        try:
+            _fit(timeline, config, os.devnull, "off", 8, "budget-probe")
+        except memledger.HbmBudgetExceeded as e:
+            if not e.breakdown and e.requested_bytes <= 0:
+                print(f"ERROR: HbmBudgetExceeded carries no forensics: {e}")
+                return 1
+            print(f"budget probe: clean typed rejection: {e}")
+            return 0
+        except Exception as e:  # noqa: BLE001 — the probe exists to type-check this
+            print(f"ERROR: budget probe raised {type(e).__name__}, "
+                  f"expected HbmBudgetExceeded: {e}")
+            return 1
+    print("ERROR: budget probe fit succeeded under a 1 KiB HBM budget")
+    return 1
+
+
 def main(argv):
     out_path = argv[0] if argv else os.environ.get(
         "FLINK_ML_TPU_TIMELINE_FILE", "timeline-events.jsonl"
@@ -78,7 +109,7 @@ def main(argv):
             "dispatch->drain cycles, expected the single-dispatch timeline"
         )
         return 1
-    return 0
+    return _budget_probe(config)
 
 
 if __name__ == "__main__":

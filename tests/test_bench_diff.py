@@ -65,6 +65,33 @@ def test_metric_directions():
     # the chunked reference side of wholeFitDispatch is informational
     assert bench_diff.metric_direction("hostSyncCountChunked") is None
     assert bench_diff.metric_direction("dispatchCountChunked") is None
+    # device-memory leaves (the HBM ledger, ISSUE 16): a fit holding more
+    # HBM or a fatter resident model regresses upward
+    assert bench_diff.metric_direction("peakHbmBytes") == "lower"
+    assert bench_diff.metric_direction("residentModelBytes") == "lower"
+    assert bench_diff.metric_direction("kmeans.peakHbmBytes") == "lower"
+
+
+def test_hbm_memory_regression_fails_gate():
+    """A fit whose peak HBM footprint doubles must REGRESS at the default
+    threshold — memory is gated like latency, no explicit --rule needed."""
+    rows = bench_diff.diff_entries(
+        {"lr": {"peakHbmBytes": 1_000_000.0, "residentModelBytes": 4096.0}},
+        {"lr": {"peakHbmBytes": 2_000_000.0, "residentModelBytes": 4096.0}},
+        0.15,
+        [],
+    )
+    verdicts = {r["path"]: r["verdict"] for r in rows}
+    assert verdicts["lr.peakHbmBytes"] == "REGRESSED"
+    assert verdicts["lr.residentModelBytes"] == "ok"
+    # shrinking memory is an improvement, never a regression
+    improved = bench_diff.diff_entries(
+        {"lr": {"peakHbmBytes": 2_000_000.0}},
+        {"lr": {"peakHbmBytes": 1_000_000.0}},
+        0.15,
+        [],
+    )
+    assert improved[0]["verdict"] != "REGRESSED"
 
 
 def test_whole_fit_dispatch_regressions_fail_gate():

@@ -94,8 +94,14 @@ class TestRunner:
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
             "retryCount", "shedCount", "rejectCount", "peakQueueDepth",
+            "peakHbmBytes", "residentModelBytes",
             "swapCount", "rollbackCount", "promoteRejected",
         }
+        # the HBM ledger fields: a KMeans fit stages centroids/batches
+        # through the accounted funnels, so the peak watermark is nonzero
+        # and the published model constants are resident after transform
+        assert result["peakHbmBytes"] > 0
+        assert 0 <= result["residentModelBytes"] <= result["peakHbmBytes"]
         assert result["hostSyncCount"] >= 1  # the packed fit readback
         # dispatch-wall attribution fields: the Lloyd program launch rides
         # the timed_dispatch funnel, and the gap is bounded by the work wall

@@ -1,0 +1,314 @@
+"""HBM ledger battery (docs/observability.md "Device memory"): ownership
+and tracked accounting, peak watermarks, the `memory` timeline lane,
+budget admission (typed rejection + loose-vs-off bit-identity), OOM
+forensics, and the fit-end ledger-parity acceptance criterion."""
+
+import gc
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from flink_ml_tpu import config
+from flink_ml_tpu.data.devicecache import DeviceEpochCache
+from flink_ml_tpu.linalg import Vectors
+from flink_ml_tpu.obs import memledger, timeline
+from flink_ml_tpu.parallel import prefetch
+from flink_ml_tpu.table import Table
+from flink_ml_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    memledger.reset()
+    yield
+    memledger.reset()
+
+
+def _nbytes(tree):
+    return sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(tree)
+        if isinstance(leaf, jax.Array)
+    )
+
+
+# ---------------------------------------------------------------------------
+# core accounting: ownership + tracked modes
+# ---------------------------------------------------------------------------
+
+def test_register_release_gauges_and_categories():
+    h = memledger.register("model", 4096, (32, 32), "float32", "x.py:1")
+    assert memledger.live_bytes() == 4096
+    assert memledger.live_bytes("model") == 4096
+    assert metrics.snapshot()["gauges"]["hbm.live.model"] == 4096
+    assert metrics.snapshot()["gauges"]["hbm.live"] == 4096
+    memledger.release(h)
+    assert memledger.live_bytes() == 0
+    assert metrics.snapshot()["gauges"]["hbm.live.model"] == 0
+    # double release and None are no-ops
+    memledger.release(h)
+    memledger.release(None)
+    assert memledger.live_bytes() == 0
+    with pytest.raises(ValueError, match="unknown ledger category"):
+        memledger.register("heap", 1)
+
+
+def test_track_releases_on_gc_and_never_double_counts():
+    arr = jax.device_put(np.ones((10, 10), np.float32))
+    memledger.track(arr, "scratch")
+    assert memledger.live_bytes("scratch") == arr.nbytes
+    assert memledger.tracked_nbytes(arr) == arr.nbytes
+    # re-tracking the same object (any category) is a no-op
+    memledger.track(arr, "scratch")
+    memledger.track({"again": arr}, "model")
+    assert memledger.live_bytes() == arr.nbytes
+    del arr
+    gc.collect()
+    assert memledger.live_bytes() == 0
+
+
+def test_stage_to_device_tracks_only_with_category():
+    uncategorized = prefetch.stage_to_device(np.ones(16, np.float32))
+    assert memledger.live_bytes() == 0  # cache-fed batches: cache owns them
+    tracked = prefetch.stage_to_device(
+        np.ones((8, 4), np.float32), category="optimizer"
+    )
+    assert memledger.live_bytes("optimizer") == tracked.nbytes
+    snap = memledger.snapshot()
+    assert snap["topEntries"][0]["category"] == "optimizer"
+    assert snap["topEntries"][0]["site"]  # allocation site recorded
+    del uncategorized, tracked
+    gc.collect()
+    assert memledger.live_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# peaks and watermarks
+# ---------------------------------------------------------------------------
+
+def test_peak_watermarks_and_marks():
+    tok = memledger.mark_peak()
+    h1 = memledger.register("model", 1000)
+    h2 = memledger.register("serving", 500)
+    memledger.release(h1)
+    h3 = memledger.register("scratch", 100)
+    assert memledger.peak_bytes() == 1500  # global watermark sticks
+    assert memledger.peak_since(tok) == 1500
+    # a mark opened after the spike only sees what it observed
+    tok2 = memledger.mark_peak()
+    memledger.release(h2)
+    assert memledger.peak_since(tok2) == 600
+    memledger.release(h3)
+
+
+def test_fit_peak_scope_sets_gauge():
+    with memledger.fit_peak_scope():
+        h = memledger.register("streamSegments", 2048)
+        memledger.release(h)
+    assert metrics.snapshot()["gauges"]["hbm.peak.fit"] == 2048
+
+
+# ---------------------------------------------------------------------------
+# the `memory` timeline lane (the Perfetto HBM track)
+# ---------------------------------------------------------------------------
+
+def test_memory_lane_counter_events():
+    timeline.configure(ring_size=4096)
+    try:
+        h = memledger.register("batchCache", 777)
+        memledger.release(h)
+        events = timeline.drain()
+    finally:
+        timeline.configure()
+    mem = [e for e in events if e["lane"] == timeline.LANE_MEMORY]
+    assert len(mem) == 2  # one counter sample per live-bytes change
+    assert all(e["ph"] == "C" and e["name"] == "hbm" for e in mem)
+    assert mem[0]["args"] == {"batchCache": 777}
+    # Chrome export keeps ph "C" so Perfetto renders a counter track
+    chrome = timeline.to_chrome(mem)
+    phases = {ev["ph"] for ev in chrome["traceEvents"] if ev["name"] == "hbm"}
+    assert phases == {"C"}
+
+
+# ---------------------------------------------------------------------------
+# budget admission
+# ---------------------------------------------------------------------------
+
+def test_budget_admission_typed_error_with_breakdown():
+    h = memledger.register("model", 900)
+    with config.hbm_budget_mode(1000):
+        memledger.admit(50)  # under budget: silent
+        with pytest.raises(memledger.HbmBudgetExceeded) as ei:
+            prefetch.stage_to_device(
+                np.ones(1000, np.float32), category="serving"
+            )
+    e = ei.value
+    assert e.requested_bytes == 4000
+    assert e.budget_bytes == 1000
+    assert e.breakdown == {"model": 900}  # zero categories filtered out
+    assert e.category == "serving"
+    assert "model=900" in str(e)
+    # the rejection happened BEFORE dispatch: nothing was ledgered
+    assert memledger.live_bytes() == 900
+    memledger.release(h)
+
+
+def test_budget_admission_deterministic_and_env_off_by_default():
+    assert config.hbm_budget_bytes is None  # default: admission off
+    memledger.admit(1 << 60)  # no budget -> always admits
+    with config.hbm_budget_mode(64):
+        for _ in range(3):  # deterministic: same request, same rejection
+            with pytest.raises(memledger.HbmBudgetExceeded):
+                memledger.admit(65, "scratch")
+        assert metrics.snapshot()["counters"]["hbm.budget.rejected"] >= 3
+
+
+def test_loose_budget_bit_identical_to_off():
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+
+    table = Table({
+        "features": [Vectors.dense(i, 2, 3, 4) for i in range(1, 11)],
+        "label": [0.0] * 5 + [1.0] * 5,
+    })
+
+    def coeffs():
+        model = LogisticRegression().set_max_iter(10).fit(table)
+        return np.asarray(model.coefficient)
+
+    base = coeffs()
+    with config.hbm_budget_mode(1 << 40):
+        loose = coeffs()
+    assert base.tobytes() == loose.tobytes()  # bit-identical, not approx
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def test_wrap_oom_builds_typed_error_with_snapshot(tmp_path, monkeypatch):
+    memledger.register("streamSegments", 5000, (50, 25), "float32", "opt.py:9")
+    dump_path = str(tmp_path / "hbm.json")
+    monkeypatch.setenv("FLINK_ML_TPU_HBM_DUMP", dump_path)
+    backend = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 5000")
+    wrapped = memledger.wrap_oom(backend)
+    assert isinstance(wrapped, memledger.HbmExhausted)
+    assert wrapped.snapshot["liveBytes"] == 5000
+    assert wrapped.snapshot["topEntries"][0]["site"] == "opt.py:9"
+    assert "streamSegments" in str(wrapped)
+    # the dump landed and roundtrips through the report renderer
+    dump = memledger.load_dump(dump_path)
+    assert dump == wrapped.snapshot
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    text = obs_report.render_hbm_dump(dump)
+    assert "streamSegments" in text and "opt.py:9" in text
+    # non-OOM errors and the already-typed pair pass through as None
+    assert memledger.wrap_oom(ValueError("shape mismatch")) is None
+    assert memledger.wrap_oom(wrapped) is None
+    assert (
+        memledger.wrap_oom(memledger.HbmBudgetExceeded(1, 1, {})) is None
+    )
+
+
+def test_snapshot_dump_roundtrip(tmp_path):
+    memledger.register("model", 10)
+    memledger.register("serving", 30)
+    path = str(tmp_path / "snap.json")
+    snap = memledger.dump_snapshot(path)
+    assert memledger.load_dump(path) == snap
+    assert list(snap["categories"]) == ["serving", "model"]  # ranked
+    assert json.load(open(path))["entryCount"] == 2
+
+
+# ---------------------------------------------------------------------------
+# devicecache ownership parity (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _batch(n, seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "X": jax.device_put(rng.randn(n, 4).astype(np.float32)),
+        "y": jax.device_put(rng.randn(n).astype(np.float32)),
+    }
+
+
+def test_devicecache_ledger_parity_under_adversarial_sequence():
+    one = _nbytes(_batch(10, 0))
+    cache = DeviceEpochCache(budget_bytes=3 * one)
+    cache.check_ledger_parity()  # empty == empty
+    for seed in range(5):  # inserts forcing LRU evictions
+        cache.put(seed, _batch(10, seed))
+        cache.check_ledger_parity()
+    assert len(cache) == 3  # budget holds 3
+    assert cache.get(4) is not None and cache.get(0) is None  # hit + miss
+    cache.check_ledger_parity()
+    # replacement: same key, different payload size
+    cache.put(4, _batch(20, 99))
+    cache.check_ledger_parity()
+    assert metrics.snapshot()["counters"].get("devicecache.replaceBytes", 0) > 0
+    # oversized insert is rejected without ledger drift
+    assert not cache.put("huge", _batch(1000, 7))
+    cache.check_ledger_parity()
+    cache.clear()
+    cache.check_ledger_parity()
+    assert memledger.live_bytes("batchCache") == 0
+
+
+def test_devicecache_dropped_without_clear_releases_entries():
+    cache = DeviceEpochCache(budget_bytes=1 << 20)
+    cache.put("k", _batch(10, 1))
+    assert memledger.live_bytes("batchCache") > 0
+    del cache  # a fit abandoning its loader mid-flight
+    gc.collect()
+    assert memledger.live_bytes("batchCache") == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: fit-end ledger parity on a chunked LR fit
+# ---------------------------------------------------------------------------
+
+def test_chunked_fit_end_ledger_parity():
+    """After a chunked LR smoke fit, the sum of live bytes across
+    categories equals the bytes of the arrays actually retained (the
+    published model constants + cache residue) — transients all closed
+    out through GC finalizers."""
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+
+    table = Table({
+        "features": [Vectors.dense(i, 2, 3, 4) for i in range(1, 11)],
+        "label": [0.0] * 5 + [1.0] * 5,
+    })
+    prev_chunk = config.iteration_chunk_size
+    config.iteration_chunk_size = 4
+    try:
+        with config.whole_fit_mode("off"):
+            model = LogisticRegression().set_max_iter(12).fit(table)
+            out = model.transform(table)[0]  # publishes device constants
+            np.asarray(out.column("prediction"))
+    finally:
+        config.iteration_chunk_size = prev_chunk
+    gc.collect()
+    consts = model.device_constants()
+    resident = _nbytes(consts)
+    assert resident > 0
+    assert memledger.live_bytes("model") == resident
+    assert memledger.tracked_nbytes(consts) == resident
+    # parity: everything live is exactly the retained model (+ empty cache)
+    assert memledger.live_bytes() == resident + memledger.live_bytes("batchCache")
+    assert memledger.live_bytes("batchCache") == 0
+    assert memledger.peak_bytes() >= resident  # fit transients peaked higher
+    # dropping the model closes the last entries
+    del model, consts
+    gc.collect()
+    assert memledger.live_bytes() == 0

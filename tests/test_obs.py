@@ -479,6 +479,30 @@ def test_prometheus_name_collision_check(_clean_hist):
     assert exporters.check_name_collisions() == []
 
 
+def test_prometheus_exports_hbm_gauges(_clean_hist):
+    """The HBM ledger gauges flow through the registry into a clean
+    (collision-free) Prometheus exposition."""
+    from flink_ml_tpu.obs import memledger
+
+    metrics.reset()
+    memledger.reset()
+    try:
+        h = memledger.register("model", 4096)
+        memledger.register("batchCache", 1024)
+        memledger.release(h)
+        assert exporters.check_name_collisions() == []
+        text = exporters.snapshot_prometheus()
+        for line in (
+            "flink_ml_tpu_hbm_live_model 0",
+            "flink_ml_tpu_hbm_live_batchCache 1024",
+            "flink_ml_tpu_hbm_live 1024",
+            "flink_ml_tpu_hbm_peak 5120",
+        ):
+            assert line in text, line
+    finally:
+        memledger.reset()
+
+
 def test_bench_entry_prometheus_first_class_fields():
     entry = {
         "name": "kmeans",

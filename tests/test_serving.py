@@ -354,6 +354,28 @@ def test_health_snapshot_shape():
     assert h.emaBatchMs >= 0.0
 
 
+def test_health_reports_hbm_ledger(mesh8):
+    """ROADMAP item 3 memory surface: ServerHealth carries the HBM
+    ledger's live bytes and peak watermark (docs/observability.md
+    "Device memory") — serving uploads ride the `serving` category."""
+    from flink_ml_tpu.obs import memledger
+
+    memledger.reset()
+    try:
+        pm = _scaler_pipeline()
+        server = MicroBatchServer(pm, in_flight=2)
+        list(server.serve(StreamTable.from_batches(_batches([4, 4]))))
+        h = server.health()
+        assert h.hbmLiveBytes == memledger.live_bytes()
+        assert h.hbmPeakBytes == memledger.peak_bytes()
+        # staged serving batches + published model constants went through
+        # the accounted funnels, so the fit's peak is nonzero
+        assert h.hbmPeakBytes > 0
+        assert h.hbmLiveBytes <= h.hbmPeakBytes
+    finally:
+        memledger.reset()
+
+
 # ---------------------------------------------------------------------------
 # SLO surface: per-stage latency histograms (obs/hist.py) — ISSUE 12
 # ---------------------------------------------------------------------------

@@ -2238,3 +2238,98 @@ class TestUntimedWait:
             **LAZYJIT_STUB,
         }, ["untimed-wait"])
         assert any(f.rule == "unused-suppression" for f in stale.findings)
+
+# ---------------------------------------------------------------------------
+# unledgered-residency
+# ---------------------------------------------------------------------------
+
+class TestUnledgeredResidency:
+    def test_true_positive_module_level_and_self_attr(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad.py": """
+                import jax
+                import jax.numpy as jnp
+
+                LUT = jnp.arange(1024)
+
+                class Model:
+                    def publish(self, weights):
+                        self._weights = jax.device_put(weights)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unledgered-residency"])
+        assert len(report.findings) == 2
+        by_binding = {f.data[1]: f.data[0] for f in report.findings}
+        assert by_binding == {
+            "module-level name": "jax.numpy.arange",
+            "self._weights": "jax.device_put",
+        }
+        assert all(f.rule == "unledgered-residency" for f in report.findings)
+
+    def test_true_positive_bare_import_and_from_jax_numpy(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/bad2.py": """
+                from jax import device_put
+                from jax import numpy as jnp
+
+                class Model:
+                    def __init__(self, k, d):
+                        self._centroids = jnp.zeros((k, d))
+
+                    def publish(self, w):
+                        self._w = device_put(w)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unledgered-residency"])
+        creators = sorted(f.data[0] for f in report.findings)
+        assert creators == ["jax.device_put", "jax.numpy.zeros"]
+
+    def test_true_negative_transients_funnels_and_host_arrays(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/good.py": """
+                import jax.numpy as jnp
+                import numpy as np
+
+                from ..parallel import prefetch
+                from ..obs import memledger
+
+                HOST_TABLE = np.zeros(16)  # host memory, not HBM
+
+                def step(X):
+                    mask = jnp.ones(X.shape[0])  # function-local transient
+                    return X * mask
+
+                class Model:
+                    def publish(self, weights):
+                        # the accounted funnel ledgers this residency
+                        self._weights = prefetch.stage_to_device(
+                            weights, category="model"
+                        )
+
+                    def adopt(self, arrs):
+                        self._arrs = memledger.track(arrs, "model")
+            """,
+            **LAZYJIT_STUB,
+            "parallel/__init__.py": "",
+            "obs/__init__.py": "",
+            "models/__init__.py": "",
+        }, ["unledgered-residency"])
+        assert report.findings == []
+
+    def test_suppression_with_reason_hides_finding(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/tiny.py": """
+                import jax.numpy as jnp
+
+                class Probe:
+                    def __init__(self):
+                        # tpulint: disable=unledgered-residency -- 8-byte sentinel, below any budget's noise floor
+                        self._sentinel = jnp.zeros(1)
+            """,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["unledgered-residency"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
