@@ -391,6 +391,140 @@ def bench_wide_sparse_lr(num_rows=1_000_000, dim=1_000_000, nnz=39):
     }
 
 
+def bench_sparse_2d_mesh(n=4096, dim=100_000, nnz=8, max_iter=8, batch_rows=1024):
+    """The feature-sharded (data x feature) 2D-mesh workload (ISSUE 17,
+    PAPER §2.3's beyond-HBM motivation): sparse LR with the coefficient
+    AND the SGD grad carry living as model-axis slices while batches
+    shard over data. Reports per-axis collective wire bytes (the SparCML
+    pair exchange on `data`, active-feature assembly psums on `model`),
+    per-shard carry residency vs the replicated layout (satellite:
+    hbm.live.* reads ONE shard, never the sum across virtual hosts), the
+    whole-fit ONE-dispatch contract on the 2D program, GSPMD-vs-2D
+    coefficient agreement on the same mesh, and the admission
+    acceptance: under a budget below one replicated f32 copy the 2D
+    layout trains while replicated staging is refused with the typed
+    HbmBudgetExceeded (docs/performance.md "2D mesh")."""
+    import jax
+
+    from flink_ml_tpu import config
+    from flink_ml_tpu.obs import memledger
+    from flink_ml_tpu.ops.losses import SPARSE_BINARY_LOGISTIC_LOSS
+    from flink_ml_tpu.ops.optimizer import SGD
+    from flink_ml_tpu.parallel import collectives, overlap
+    from flink_ml_tpu.parallel import mesh as mesh_lib
+    from flink_ml_tpu.parallel import prefetch as h2d
+    from flink_ml_tpu.utils import metrics
+
+    n_dev = len(jax.devices())
+    model_shards = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    rng = np.random.default_rng(17)
+    indices = rng.integers(0, dim, size=(n, nnz)).astype(np.int32)
+    values = rng.random((n, nnz))
+    y = rng.integers(0, 2, size=n).astype(np.float64)
+    init = np.zeros(dim)
+    args = ((indices, values), y, None, SPARSE_BINARY_LOGISTIC_LOSS)
+
+    def fit(mesh, sgd):
+        with mesh_lib.use_mesh(mesh):
+            return sgd.optimize(init, *args, mesh=mesh)
+
+    def per_shard_bytes(mesh):
+        # what the ledger sees for ONE staged carry under each layout —
+        # per-device residency, not the sum across shards
+        memledger.reset()
+        staged = h2d.stage_to_device(
+            np.zeros(dim, np.float32), mesh_lib.model_sharding(mesh),
+            category="optimizer",
+        )
+        live = memledger.live_bytes("optimizer")
+        del staged
+        memledger.reset()
+        return live
+
+    mesh2d = mesh_lib.create_mesh_2d(model_shards)
+    mesh1d = mesh_lib.create_mesh((mesh_lib.DATA_AXIS,))
+    sgd = SGD(
+        max_iter=max_iter, learning_rate=LR_RATE,
+        global_batch_size=min(batch_rows, n), tol=0.0, shard_features=True,
+    )
+
+    # cold run: compile + trace-time per-axis wire accounting
+    overlap.clear_program_cache()
+    before = metrics.snapshot()
+    t0 = time.perf_counter()
+    fit(mesh2d, sgd)
+    cold = time.perf_counter() - t0
+    wire = collectives.axis_wire_bytes(
+        metrics.snapshot_delta(before, metrics.snapshot())
+    )
+
+    # warm run: wall, dispatch count, peak residency
+    memledger.reset()
+    mark = memledger.mark_peak()
+    before = metrics.snapshot()
+    t0 = time.perf_counter()
+    coeff, loss, epochs = fit(mesh2d, sgd)
+    warm = time.perf_counter() - t0
+    delta = metrics.snapshot_delta(before, metrics.snapshot())
+    peak_2d = memledger.peak_since(mark)
+    dispatches = int(delta["timers"].get("iteration.dispatch", {}).get("count", 0))
+    assert dispatches == 1, f"2D whole fit paid {dispatches} dispatches"
+
+    # replicated reference on the same devices (1D mesh: model_sharding
+    # falls back to replication) — peak watermark + GSPMD agreement
+    memledger.reset()
+    mark = memledger.mark_peak()
+    rep_coeff, _, rep_epochs = fit(mesh1d, sgd)
+    peak_rep = memledger.peak_since(mark)
+    memledger.reset()
+    assert rep_epochs == epochs
+    assert np.allclose(coeff, rep_coeff, rtol=3e-5, atol=3e-6), (
+        "2D coefficients diverged from the replicated reference"
+    )
+
+    # admission acceptance: budget below ONE replicated f32 copy
+    refused = 0.0
+    if model_shards > 1:
+        with config.hbm_budget_mode(3 * dim):
+            fit(mesh2d, sgd)  # per-shard carries fit
+            try:
+                fit(mesh1d, sgd)
+            except memledger.HbmBudgetExceeded:
+                refused = 1.0
+        memledger.reset()
+        assert refused == 1.0, "replicated staging was not refused at budget"
+
+    log(
+        f"sparse2dMesh: ({n_dev // model_shards}x{model_shards}) mesh, dim {dim}: "
+        f"fit {warm * 1000:.0f} ms ({dispatches} dispatch), wire "
+        f"data {wire.get('data', 0)}B / model {wire.get('model', 0)}B, peak "
+        f"{peak_2d}B vs replicated {peak_rep}B"
+    )
+    return {
+        "inputRecordNum": n,
+        "dim": dim,
+        "nnzPerRow": nnz,
+        "maxIter": max_iter,
+        "dataShards": n_dev // model_shards,
+        "modelShards": model_shards,
+        "coldTimeMs": cold * 1000.0,
+        "wallMs": warm * 1000.0,
+        "trainedExamplesPerSec": min(batch_rows, n) * max_iter / warm,
+        "finalLoss": float(loss),
+        # gated lower-better leaves (scripts/bench_diff.py direction rules)
+        "dispatchCount": dispatches,
+        "dataAxisWireBytes": int(wire.get("data", 0)),
+        "modelAxisWireBytes": int(wire.get("model", 0)),
+        "peakHbmBytes": int(peak_2d),
+        "optimizerPerShardBytes": int(per_shard_bytes(mesh2d)),
+        # informational reference side (no direction: *Replicated)
+        "peakHbmBytesReplicated": int(peak_rep),
+        "optimizerBytesReplicated": int(per_shard_bytes(mesh1d)),
+        "agreesWithGspmdReference": 1.0,  # asserted above
+        "replicatedRefusedAtBudget": refused,
+    }
+
+
 def bench_kmeans():
     """The reference README's only published number (10k x dim 10, k=2)."""
     from flink_ml_tpu.models.clustering.kmeans import KMeans
@@ -1580,6 +1714,12 @@ def main(argv):
                 details["sparseWideLR"] = bench_wide_sparse_lr()
             except Exception as e:
                 log(f"sparseWideLR stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["sparse2dMesh"] = bench_sparse_2d_mesh()
+            except Exception as e:
+                log(f"sparse2dMesh stage failed: {e!r}")
 
         if in_budget():
             try:

@@ -142,6 +142,29 @@ def collective_overlap_mode(enabled: bool = True):
         collective_overlap = prev
 
 
+# True 2D (data × model) sparse training (docs/performance.md "2D mesh"):
+# "auto" routes a feature-sharded sparse fit on a mesh with a real model
+# axis through the explicit-SPMD 2D programs (parallel/overlap.py
+# sgd2d_*: coeff + optimizer carries live as model-axis slices, gradients
+# reduce over the data axis only). "off" keeps the GSPMD 1D program —
+# the replicated-residency reference the 2D parity tests compare against.
+sparse_2d: str = "auto"
+
+
+@contextmanager
+def sparse_2d_mode(mode: str):
+    """Scoped override of `sparse_2d` ("auto" | "off")."""
+    global sparse_2d
+    if mode not in ("auto", "off"):
+        raise ValueError(f"sparse_2d must be 'auto' or 'off', got {mode!r}")
+    prev = sparse_2d
+    sparse_2d = mode
+    try:
+        yield
+    finally:
+        sparse_2d = prev
+
+
 def resolve_chunk_bytes(chunk_bytes: Optional[int] = None) -> Optional[int]:
     """Effective collective bucket size: explicit argument > process-wide
     `collective_chunk_bytes`. None/<=0 means unchunked (one bucket)."""
@@ -153,6 +176,8 @@ def resolve_chunk_bytes(chunk_bytes: Optional[int] = None) -> Optional[int]:
 
 if os.environ.get("FLINK_ML_TPU_COLLECTIVE_OVERLAP") in ("1", "true", "on"):
     collective_overlap = True
+if os.environ.get("FLINK_ML_TPU_SPARSE_2D") in ("auto", "off"):
+    sparse_2d = os.environ["FLINK_ML_TPU_SPARSE_2D"]
 if os.environ.get("FLINK_ML_TPU_COLLECTIVE_CHUNK_BYTES"):
     collective_chunk_bytes = int(os.environ["FLINK_ML_TPU_COLLECTIVE_CHUNK_BYTES"])
 

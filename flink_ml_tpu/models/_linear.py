@@ -129,6 +129,15 @@ def run_sgd(
         # the Pallas-kernel route when config.use_pallas_sparse is on
         loss_func = sparse_variant(loss_func.name)
         init_coeff = np.zeros(dim, dtype=np.float64)
+        # a mesh with a model axis declares the feature-sharded intent:
+        # wide sparse estimator fits take the 2D (data × model) layout
+        # automatically (coeff + optimizer carries as model-axis slices,
+        # see ops.optimizer.SGD._use_2d / docs/performance.md "2D mesh")
+        from ..parallel import mesh as mesh_lib
+
+        optimizer.shard_features = (
+            mesh_lib.MODEL_AXIS in mesh_lib.default_mesh().axis_names
+        )
     else:
         init_coeff = np.zeros(X.shape[1], dtype=np.float64)
     result = optimizer.optimize_async(

@@ -6,6 +6,14 @@ Training runs the shared SGD engine (ops/optimizer.py) as one XLA
 while-loop over the device mesh; inference is a single jitted
 matvec+sigmoid over the whole table instead of a per-row broadcast-model
 map function.
+
+Sparse (SparseBatch) features train on the padded-CSR path without
+densifying, and when the active mesh carries a `model` axis
+(`parallel.mesh.create_mesh_2d`) the fit runs feature-sharded on the
+true 2D (data × model) layout: the coefficient and optimizer carries
+live as model-axis slices, so a Criteo-scale dim whose replicated
+residency exceeds `config.hbm_budget_bytes` still trains (see
+docs/performance.md "2D mesh").
 """
 
 from __future__ import annotations

@@ -260,12 +260,43 @@ def _leaf_arrays(tree) -> Iterable[Any]:
             yield leaf
 
 
+def _resident_nbytes(arr) -> int:
+    """PER-DEVICE resident bytes of a device array: the bytes of ONE shard
+    under the array's sharding, not the global `nbytes`. The ledger models
+    a single device's HBM (the budget is per-device capacity), so a
+    model-axis-sharded (d,) carry on an nm-way mesh ledgers d/nm — that
+    difference IS the beyond-HBM headroom the 2D mesh buys, and summing
+    global bytes would erase it. Replicated and single-device arrays have
+    shard shape == global shape, so their accounting is unchanged."""
+    nbytes = int(getattr(arr, "nbytes", 0))
+    sharding = getattr(arr, "sharding", None)
+    shape = getattr(arr, "shape", None)
+    if sharding is None or shape is None or not hasattr(sharding, "shard_shape"):
+        return nbytes
+    try:
+        shard_shape = sharding.shard_shape(tuple(shape))
+    except (TypeError, ValueError):
+        return nbytes
+    total = 1
+    for s in shape:
+        total *= int(s)
+    if total <= 0:
+        return nbytes
+    shard = 1
+    for s in shard_shape:
+        shard *= int(s)
+    return (nbytes * shard) // total
+
+
 def track(tree, category: str, site: Optional[str] = None):
     """Ledger every device-array leaf of `tree` under `category`,
     auto-releasing each entry when the array object is garbage
     collected (`weakref.finalize` — verified supported on jax arrays).
     Already-tracked leaves are skipped, so re-staging or re-tracking the
-    same array never double-counts. Returns `tree` for chaining."""
+    same array never double-counts. Sharded leaves ledger PER-DEVICE
+    shard bytes (see `_resident_nbytes`): `hbm.live.<category>` reads as
+    one device's residency, never the sum across virtual hosts. Returns
+    `tree` for chaining."""
     if site is None:
         site = _call_site()
     for arr in _leaf_arrays(tree):
@@ -275,7 +306,7 @@ def track(tree, category: str, site: Optional[str] = None):
                 continue
         handle = register(
             category,
-            int(getattr(arr, "nbytes", 0)),
+            _resident_nbytes(arr),
             shape=tuple(getattr(arr, "shape", ())),
             dtype=str(getattr(arr, "dtype", "")),
             site=site,

@@ -167,6 +167,103 @@ PALLAS_SPARSE_VARIANTS = {
 }
 
 
+def _feature_sharded(pointwise):
+    """Padded-CSR batched loss for the explicit 2D `(data, model)` mesh
+    (parallel/overlap.py `sgd2d_*`): runs INSIDE a shard_map body where
+    `coeff` is this MODEL shard's contiguous feature slice (d_local,) at
+    offset `axis_index(model) * d_local`, and (indices, values, y, w) are
+    this DATA shard's batch rows with GLOBAL feature indices.
+
+    Forward — active-feature all-gather over the model axis: each shard
+    gathers only the active slots it OWNS (masked local gather) and the
+    per-(row, slot) psum assembles the full active slice, since exactly
+    one shard contributes a non-zero per slot (0 + x == x exactly). Wire
+    bytes over `model` are B*nnz*itemsize — the dense (d,) vector never
+    crosses a link, which is what makes beyond-HBM dims affordable.
+
+    Gradient — data-axis-restricted reduce: the per-row multiplier
+    contributions scatter into LOCAL slice coordinates (non-owned slots
+    get index -1, dropped by the scatter), and reduce over `data` alone
+    via the SparCML index-value exchange (pair bytes ∝ nnz) or, above the
+    density threshold, the densified (d_local,) chunked reduce. The
+    returned (loss_sum, weight_sum) are psum'd over `data` so the carry
+    criteria are uniform — `_epoch_step` then applies the same update
+    math as every other layout, on this shard's slice."""
+
+    def fn(X, y, w, coeff) -> LossOut:
+        import numpy as np
+
+        from ..parallel import collectives
+        from ..parallel.collectives import DATA_AXIS, MODEL_AXIS
+
+        indices, values = X
+        d_local = coeff.shape[0]
+        lo = collectives.axis_index(MODEL_AXIS) * d_local
+        valid = indices >= 0
+        vals = jnp.where(valid, values, 0.0).astype(coeff.dtype)
+        owned = valid & (indices >= lo) & (indices < lo + d_local)
+        # the 1D sparse_dot masking convention, restricted to OWNED slots:
+        # slot 0 with value +0.0 for everything this shard does not own
+        # (a negative scatter index would WRAP to d_local-1, not drop)
+        safe = jnp.where(owned, indices - lo, 0)
+        owned_vals = jnp.where(owned, vals, 0.0)
+        coeff_active = collectives.all_reduce_sum(
+            jnp.where(owned, coeff[safe], 0.0), MODEL_AXIS
+        )
+        dot = jnp.sum(vals * coeff_active, axis=1)
+        loss, multiplier = pointwise(dot, y, w)
+        contrib = owned_vals * multiplier[:, None]
+        rows, nnz = indices.shape
+        itemsize = np.dtype(values.dtype).itemsize
+        if collectives.sparse_reduce_wins(rows * nnz, d_local, itemsize=itemsize):
+            grad = collectives.sparse_all_reduce_sum(
+                safe, contrib, d_local, DATA_AXIS
+            )
+        else:
+            grad = collectives.all_reduce_sum_chunked(
+                jnp.zeros_like(coeff).at[safe].add(contrib, mode="drop"),
+                DATA_AXIS,
+            )
+        sums = collectives.all_reduce_sum(
+            jnp.stack([jnp.sum(loss), jnp.sum(w).astype(loss.dtype)]), DATA_AXIS
+        )
+        return sums[0], grad, sums[1].astype(w.dtype)
+
+    return fn
+
+
+FEATURE_SHARDED_BINARY_LOGISTIC_LOSS = LossFunc(
+    "sparse_binary_logistic_2d", _feature_sharded(_logistic_pointwise),
+    _logistic_pointwise, True,
+)
+FEATURE_SHARDED_HINGE_LOSS = LossFunc(
+    "sparse_hinge_2d", _feature_sharded(_hinge_pointwise), _hinge_pointwise, True
+)
+FEATURE_SHARDED_LEAST_SQUARE_LOSS = LossFunc(
+    "sparse_least_square_2d", _feature_sharded(_least_square_pointwise),
+    _least_square_pointwise, True,
+)
+
+#: sparse (and pallas-sparse) loss name -> its 2D feature-sharded variant.
+#: The pallas names map to the same plain variant: the 2D body's masked
+#: slice gather is not the kernel the pallas route hand-writes.
+FEATURE_SHARDED_VARIANTS = {
+    SPARSE_BINARY_LOGISTIC_LOSS.name: FEATURE_SHARDED_BINARY_LOGISTIC_LOSS,
+    SPARSE_HINGE_LOSS.name: FEATURE_SHARDED_HINGE_LOSS,
+    SPARSE_LEAST_SQUARE_LOSS.name: FEATURE_SHARDED_LEAST_SQUARE_LOSS,
+    PALLAS_SPARSE_BINARY_LOGISTIC_LOSS.name: FEATURE_SHARDED_BINARY_LOGISTIC_LOSS,
+    PALLAS_SPARSE_HINGE_LOSS.name: FEATURE_SHARDED_HINGE_LOSS,
+    PALLAS_SPARSE_LEAST_SQUARE_LOSS.name: FEATURE_SHARDED_LEAST_SQUARE_LOSS,
+}
+
+
+def feature_sharded_variant(loss_func: LossFunc) -> LossFunc:
+    """The 2D (data, model) LossFunc for a sparse loss. A DISTINCT cached
+    LossFunc object per base loss (the loss is a jit static argument), so
+    the 2D programs never collide with the 1D executables."""
+    return FEATURE_SHARDED_VARIANTS[loss_func.name]
+
+
 def sparse_variant(name: str) -> LossFunc:
     """The padded-CSR LossFunc for the dense loss `name`, routed to the
     Pallas kernels under `config.use_pallas_sparse`. The two routes are

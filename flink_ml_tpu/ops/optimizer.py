@@ -473,6 +473,21 @@ def read_train_result(async_result):
         _, coeff, criteria, epochs, flag, d = async_result
         # tpulint: disable=host-sync-leak -- host-driven branch: coeff is already host numpy here, the copy is free
         return flag, np.asarray(coeff)[:d], criteria, epochs
+    if async_result[0] == "packed2d":  # 2D (data × model) whole-fit path
+        from ..parallel.overlap import sgd2d_unpack_host
+
+        _, packed, d, has_flag, nm, d_local = async_result
+        # ONE device_get of the model-sharded pack (per-shard block =
+        # [flag?, coeff_slice, criteria, epochs]) — no device hops a full
+        # replicated result vector, matching the sharded residency story
+        t0 = time.perf_counter()
+        host = np.asarray(jax.device_get(packed))
+        tracing.account_host_sync("fit")
+        tracing.account_readback(host.nbytes, time.perf_counter() - t0)
+        coeff, criteria, epochs, flag = sgd2d_unpack_host(
+            host, nm, d_local, has_flag
+        )
+        return flag, coeff[:d], criteria, epochs
     _, packed, d, has_flag = async_result
     # explicit device_get: the transfer-guard readback-budget tests run
     # fits under jax.transfer_guard("disallow") to catch stray implicit pulls
@@ -532,6 +547,34 @@ class SGD:
             else config.collective_overlap
         )
         return bool(on) and not self.shard_features and self.checkpoint_dir is None
+
+    def _use_2d(self, mesh: Mesh, loss_func: LossFunc) -> bool:
+        """Route this fit through the explicit 2D (data × model) programs
+        (parallel/overlap.py sgd2d_*)? Requires a feature-sharded SPARSE
+        fit on a mesh that actually has a model axis; `config.sparse_2d`
+        = "off" keeps the GSPMD 1D program — the replicated-residency
+        reference the 2D parity tests compare against. A 1-shard model
+        axis still routes 2D (the axis collectives are identity-sized),
+        which is what makes single-feature-shard bit-parity testable."""
+        from .. import config
+
+        return (
+            self.shard_features
+            and loss_func.sparse
+            and config.sparse_2d == "auto"
+            and mesh_lib.MODEL_AXIS in mesh.axis_names
+        )
+
+    def _stage_2d_grad(self, mesh: Mesh, d: int):
+        """The zero gradient carry staged DIRECTLY as model-axis slices:
+        the optimizer state's (d,) leaves must never materialize
+        replicated on a beyond-HBM dim — staging through the admission
+        funnel also ledgers d/nm per-device bytes under `optimizer`."""
+        return h2d.stage_to_device(
+            np.zeros((d,), self.dtype),
+            mesh_lib.model_sharding(mesh),
+            category="optimizer",
+        )
 
     def _hyper(self) -> np.ndarray:
         """The packed f32 hyper-parameter vector every kernel consumes —
@@ -648,6 +691,24 @@ class SGD:
             if validate_labels:
                 flag = float(jax.device_get(_binomial_labels_ok(y_b)))
             return ("host", coeff, criteria, epochs, flag, d)
+        if self._use_2d(mesh, loss_func) and isinstance(X_b, tuple):
+            from ..parallel import overlap
+
+            carry = (
+                jnp.asarray(init, self.dtype),
+                self._stage_2d_grad(mesh, d_pad),
+                jnp.asarray(0.0, self.dtype),
+                jnp.asarray(0, jnp.int32),
+            )
+            _, _, packed = dispatch.timed_dispatch(
+                overlap.sgd2d_whole_fit,
+                mesh, X_b, y_b, w_b, carry,
+                jnp.asarray(np.inf, jnp.float32),
+                loss_func, self._hyper(), validate_labels,
+                start=0, end=self.max_iter,
+            )
+            nm = mesh_lib.num_model_shards(mesh)
+            return ("packed2d", packed, d, validate_labels, nm, d_pad // nm)
         packed = dispatch.timed_dispatch(
             _sgd_train,
             X_b,
@@ -1144,9 +1205,14 @@ class SGD:
         d = init_coeff.shape[0]  # X_b may be the sparse (indices, values) tuple
         nb = int(y_b.shape[0])
         hyper = self._hyper()
+        use_2d = self._use_2d(mesh, loss_func) and isinstance(X_b, tuple)
+        if use_2d:
+            from ..parallel import overlap
         carry = (
             jnp.asarray(init_coeff, self.dtype),
-            jnp.zeros((d,), self.dtype),
+            self._stage_2d_grad(mesh, d)
+            if use_2d
+            else jnp.zeros((d,), self.dtype),
             jnp.asarray(0.0, self.dtype),
             jnp.asarray(0, jnp.int32),
         )
@@ -1192,16 +1258,28 @@ class SGD:
             with tracing.span(
                 "iteration.run", mode="whole_fit", epochs=self.max_iter
             ):
-                carry, crit_dev, packed = dispatch.timed_dispatch(
-                    _sgd_whole_fit,
-                    X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
-                    self._pack_sharding(mesh),
-                    start=epoch, end=self.max_iter,
-                )
-                (host,) = packed_device_get(packed, sync_kind="fit")
-                _, coeff_h, final_crit, final_epoch = unpack_train_result(
-                    np.asarray(host), d
-                )
+                if use_2d:
+                    carry, crit_dev, packed = dispatch.timed_dispatch(
+                        overlap.sgd2d_whole_fit,
+                        mesh, X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
+                        start=epoch, end=self.max_iter,
+                    )
+                    (host,) = packed_device_get(packed, sync_kind="fit")
+                    nm = mesh_lib.num_model_shards(mesh)
+                    coeff_h, final_crit, final_epoch, _ = overlap.sgd2d_unpack_host(
+                        np.asarray(host), nm, d // nm, False
+                    )
+                else:
+                    carry, crit_dev, packed = dispatch.timed_dispatch(
+                        _sgd_whole_fit,
+                        X_b, y_b, w_b, carry, crit_dev, loss_func, hyper,
+                        self._pack_sharding(mesh),
+                        start=epoch, end=self.max_iter,
+                    )
+                    (host,) = packed_device_get(packed, sync_kind="fit")
+                    _, coeff_h, final_crit, final_epoch = unpack_train_result(
+                        np.asarray(host), d
+                    )
                 if final_epoch > epoch and final_epoch % interval == 0:
                     _snapshot.save_job_snapshot(
                         self.checkpoint_dir,
@@ -1253,9 +1331,20 @@ class SGD:
                     dispatch.next_boundary(planned, interval),
                 )
                 retain = end % interval == 0
-                step = (
-                    _sgd_chunk_donating if (donate_next and donate_ok) else _sgd_chunk
-                )
+                if use_2d:
+                    # 2D chunks always borrow: the sharded carry must stay
+                    # readable for a pending snapshot write, and the
+                    # shard_map program re-enters its cached executable
+                    def step(Xb, yb, wb, c, crit, lf, hy, ce):
+                        return overlap.sgd2d_chunk(
+                            mesh, Xb, yb, wb, c, crit, lf, hy, ce
+                        )
+                else:
+                    step = (
+                        _sgd_chunk_donating
+                        if (donate_next and donate_ok)
+                        else _sgd_chunk
+                    )
                 with tracing.span("iteration.chunk", epoch=planned, end=end):
                     carry, crit_dev, packed = dispatch.timed_dispatch(
                         step,

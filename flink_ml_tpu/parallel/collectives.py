@@ -101,6 +101,33 @@ def _account(op: str, x, axis_name: str, chunks: int = None, dense_equiv_bytes: 
     # size histograms, not just byte sums — a p99 payload far above p50
     # says the bucketing layer is emitting stragglers)
     hist.record("collective.payloadBytes", nbytes)
+    # per-AXIS attribution: on a 2D (data, model) mesh the two axes carry
+    # different traffic classes (nnz-proportional gradient pairs over
+    # `data`, active-feature slices over `model`), so the wire-byte
+    # evidence must not collapse into one counter — the sparse2dMesh
+    # BENCH entry reads these to report per-axis wire bytes, and the
+    # per-axis sparse ratio keeps a model-axis reduce from diluting the
+    # data-axis traffic-proportionality claim
+    metrics.inc_counter(f"collective.axis.{axis_name}.calls")
+    metrics.inc_counter(f"collective.axis.{axis_name}.bytes", int(nbytes))
+    if dense_equiv_bytes:
+        metrics.inc_counter(
+            f"collective.axis.{axis_name}.sparse.bytes", int(nbytes)
+        )
+        metrics.inc_counter(
+            f"collective.axis.{axis_name}.sparse.dense_equiv_bytes",
+            int(dense_equiv_bytes),
+        )
+        metrics.set_gauge(
+            f"collective.sparse_ratio.{axis_name}",
+            metrics.get_counter(f"collective.axis.{axis_name}.sparse.bytes")
+            / max(
+                metrics.get_counter(
+                    f"collective.axis.{axis_name}.sparse.dense_equiv_bytes"
+                ),
+                1,
+            ),
+        )
     tracing.account_collective(
         op,
         nbytes,
@@ -108,6 +135,23 @@ def _account(op: str, x, axis_name: str, chunks: int = None, dense_equiv_bytes: 
         axis_name,
         dense_equiv_bytes=dense_equiv_bytes,
     )
+
+
+def axis_wire_bytes(snapshot_delta: dict = None) -> Dict[str, int]:
+    """Per-axis collective wire bytes from the (delta) metrics counters:
+    {axis: bytes}. Pass a `metrics.snapshot_delta` to scope to one entry;
+    defaults to the live registry."""
+    counters = (
+        snapshot_delta.get("counters", {})
+        if snapshot_delta is not None
+        else metrics.snapshot()["counters"]
+    )
+    out: Dict[str, int] = {}
+    for name, value in counters.items():
+        parts = name.split(".")
+        if len(parts) == 4 and parts[:2] == ["collective", "axis"] and parts[3] == "bytes":
+            out[parts[2]] = int(value)
+    return out
 
 
 def axis_size(axis_name: str = DATA_AXIS) -> int:

@@ -69,8 +69,60 @@ def use_mesh(mesh: Mesh):
         _default_mesh = prev
 
 
+def create_mesh_2d(
+    model_shards: int,
+    devices=None,
+    num_hosts: Optional[int] = None,
+) -> Mesh:
+    """Build the true 2D `(data, model)` training mesh: the device grid
+    factorized as (device_count / model_shards) × model_shards with the
+    MODEL axis innermost.
+
+    Innermost-model is the layout that keeps the factorization host-group
+    aware: `host_groups` (and real multi-host process boundaries) slice the
+    flat device order into contiguous slabs, and with the model axis minor
+    each slab owns WHOLE data-axis rows — a feature-axis all-gather stays
+    inside one host's ICI domain while the data-axis gradient reduce is
+    the only collective that crosses host slabs (the Snap ML hierarchy:
+    TP inside the node, DP across nodes). With `num_hosts` the alignment
+    is validated up front: every host slab must hold a multiple of
+    `model_shards` devices, otherwise a data row straddles hosts and the
+    cheap-axis/expensive-axis split silently inverts.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    model_shards = int(model_shards)
+    if model_shards < 1:
+        raise ValueError(f"model_shards must be >= 1, got {model_shards}")
+    if len(devices) % model_shards:
+        raise ValueError(
+            f"model_shards={model_shards} does not divide {len(devices)} devices"
+        )
+    if num_hosts is not None:
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        for h, group in enumerate(
+            np.array_split(np.arange(len(devices)), num_hosts)
+        ):
+            if len(group) % model_shards:
+                raise ValueError(
+                    f"host {h} owns {len(group)} of {len(devices)} devices — "
+                    f"not a multiple of model_shards={model_shards}; a "
+                    "data-axis row would straddle hosts (re-factor the grid "
+                    "or change the host count)"
+                )
+    return create_mesh(
+        (DATA_AXIS, MODEL_AXIS),
+        shape=(len(devices) // model_shards, model_shards),
+        devices=devices,
+    )
+
+
 def num_data_shards(mesh: Mesh) -> int:
     return int(mesh.shape.get(DATA_AXIS, 1))
+
+
+def num_model_shards(mesh: Mesh) -> int:
+    return int(mesh.shape.get(MODEL_AXIS, 1))
 
 
 def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
@@ -85,6 +137,24 @@ def model_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     if MODEL_AXIS not in mesh.axis_names:
         return replicated_sharding(mesh)
     return NamedSharding(mesh, P(*([None] * (ndim - 1)), MODEL_AXIS))
+
+
+def data_model_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """The 2D training layout for rank >= 2 operands: leading (batch) dim
+    over `data`, trailing (feature) dim over `model`, middle dims
+    replicated — batches split across data shards while each data row's
+    feature slice splits across the model axis. Falls back to the plain
+    data layout when the mesh has no model axis."""
+    if ndim < 2:
+        raise ValueError(
+            f"data_model_sharding needs ndim >= 2 (got {ndim}); rank-1 "
+            "operands are either data_sharding or model_sharding"
+        )
+    if MODEL_AXIS not in mesh.axis_names:
+        return data_sharding(mesh, ndim)
+    return NamedSharding(
+        mesh, P(DATA_AXIS, *([None] * (ndim - 2)), MODEL_AXIS)
+    )
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

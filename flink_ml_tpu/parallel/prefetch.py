@@ -77,6 +77,40 @@ def _host_nbytes(tree) -> int:
     return total
 
 
+def _admit_nbytes(tree, sharding) -> int:
+    """Bytes the staging will make RESIDENT on one device — what budget
+    admission must check, as distinct from `_host_nbytes` (bytes crossing
+    the tunnel). With a sharding that splits the arrays, each device
+    receives only its shard: a model-axis-sharded (d,) carry admits d/nm
+    bytes against the per-device `config.hbm_budget_bytes`, which is
+    exactly how the 2D mesh trains models whose replicated staging is
+    rejected. No sharding (or a replicated one) admits the full bytes —
+    identical to the pre-2D behaviour."""
+    if sharding is None or not hasattr(sharding, "shard_shape"):
+        return _host_nbytes(tree)
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            continue  # already resident: re-placement, not new residency
+        nbytes = int(getattr(leaf, "nbytes", 0))
+        shape = tuple(getattr(leaf, "shape", ()))
+        size = 1
+        for s in shape:
+            size *= int(s)
+        try:
+            shard_shape = sharding.shard_shape(shape)
+        except (TypeError, ValueError):
+            total += nbytes
+            continue
+        ssize = 1
+        for s in shard_shape:
+            ssize *= int(s)
+        total += (nbytes * ssize) // size if size > 0 else nbytes
+    return total
+
+
 def account_h2d(nbytes: int, arrays: int = 1, seconds: Optional[float] = None) -> None:
     """Fold one host→device transfer into the registry — the upload-side
     sibling of `obs.tracing.account_readback`. When the caller measured
@@ -120,7 +154,7 @@ def stage_to_device(tree, sharding=None, category: Optional[str] = None):
     import jax
 
     nbytes = _host_nbytes(tree)
-    memledger.admit(nbytes, category)
+    memledger.admit(_admit_nbytes(tree, sharding), category)
     t0 = time.perf_counter()
     try:
         if sharding is not None:
@@ -150,7 +184,13 @@ def stage_from_callback(shape, sharding, data_callback, category: Optional[str] 
 
     import jax
 
-    memledger.admit(int(np.prod(shape)) * 4, category)
+    admit_shape = tuple(shape)
+    if hasattr(sharding, "shard_shape"):
+        try:
+            admit_shape = sharding.shard_shape(tuple(shape))
+        except (TypeError, ValueError):
+            pass
+    memledger.admit(int(np.prod(admit_shape)) * 4, category)
     t0 = time.perf_counter()
     try:
         out = jax.make_array_from_callback(tuple(shape), sharding, data_callback)

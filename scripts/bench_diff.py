@@ -73,6 +73,11 @@ _LOWER_BETTER = (
     # dispatch-count regression (docs/observability.md "Device memory")
     "peakhbmbytes",
     "residentmodelbytes",
+    # 2D (data x model) mesh entries (docs/performance.md "2D mesh"): a
+    # fatter per-shard carry or more collective wire traffic per fit
+    # regresses in the same direction as the watermarks above
+    "pershardbytes",
+    "wirebytes",
 )
 _HIGHER_BETTER = (
     "throughput",

@@ -1696,6 +1696,63 @@ class TestSpecConsistency:
         }, ["spec-consistency"])
         assert report.findings == []
 
+    def test_true_positive_2d_model_axis_unreduced(self, tmp_path):
+        # the 2D (data x model) trap: a feature-sharded product reduced
+        # over DATA only but declared fully replicated — the model-axis
+        # variation silently survives into the "replicated" output
+        report = _run(tmp_path, {
+            "models/bad2d.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+                def build(mesh):
+                    def body(X, coeff):
+                        grad = collectives.all_reduce_sum(X @ coeff, DATA_AXIS)
+                        return grad
+                    return collectives.shard_map_over(
+                        mesh,
+                        (P(DATA_AXIS, MODEL_AXIS), P(MODEL_AXIS)),
+                        P(), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.data[0] == "unreduced-output"
+        assert "model" in f.message
+
+    def test_true_negative_2d_sharded_carry_out(self, tmp_path):
+        # the sgd2d program in miniature: activations psum over MODEL,
+        # gradient psum over DATA, the updated carry declared P(model) —
+        # per-axis bookkeeping must see every axis resolved
+        report = _run(tmp_path, {
+            "models/good2d.py": """
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+                def build(mesh):
+                    def body(X, coeff):
+                        act = collectives.all_reduce_sum(
+                            X @ coeff, MODEL_AXIS)
+                        grad = collectives.all_reduce_sum(
+                            X.T @ act, DATA_AXIS)
+                        loss = collectives.all_reduce_sum(act, DATA_AXIS)
+                        return coeff - grad, loss
+                    return collectives.shard_map_over(
+                        mesh,
+                        (P(DATA_AXIS, MODEL_AXIS), P(MODEL_AXIS)),
+                        (P(MODEL_AXIS), P()), fn=body)
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert report.findings == []
+
     def test_unknown_specs_suppress_findings(self, tmp_path):
         # unresolvable in_specs: the engine must stay quiet, not guess
         report = _run(tmp_path, {
