@@ -912,6 +912,130 @@ def bench_whole_fit_dispatch(n=400_000, d=32, max_iter=200, batch_rows=4096):
     return result
 
 
+def bench_fleet_sweep(
+    n=100_000,
+    d=32,
+    max_iter=12,
+    batch_rows=4096,
+    fleet_sizes=(1, 32, 512),
+    in_budget=lambda: True,
+):
+    """The FitFleet many-model workload (docs/performance.md §11): the
+    SAME LR fit swept over per-member learning rates, trained as ONE
+    vmapped resident dispatch at each fleet size. Reports models/s and
+    trained-examples/s at N in {1, 32, 512}; the N=32 point asserts the
+    amortization contract in-process — ONE dispatch, ONE blocking host
+    sync for the whole fleet — and every member's coefficients
+    bit-identical to its solo whole-fit run. The gated leaves
+    (dispatchCount / hostSyncCount / modelsPerSecond /
+    trainedExamplesPerSec) come from that N=32 point."""
+    from flink_ml_tpu.fleet import FitFleet
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+    from flink_ml_tpu.table import Table
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(29)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(np.float32)
+    table = Table({"features": X, "label": y})
+
+    def member(i, size):
+        # a real sweep: every member trains a distinct hyper point
+        return (
+            LogisticRegression()
+            .set_max_iter(max_iter)
+            .set_tol(0.0)
+            .set_learning_rate(0.05 * (1.0 + i / max(1, size)))
+            .set_global_batch_size(batch_rows)
+        )
+
+    def run(size):
+        fleet = FitFleet([member(i, size) for i in range(size)])
+        fleet.fit(table)  # warm: compile the size-N program off the clock
+        before = metrics.snapshot()
+        t0 = time.perf_counter()
+        models = FitFleet([member(i, size) for i in range(size)]).fit(table)
+        wall = time.perf_counter() - t0
+        delta = metrics.snapshot_delta(before, metrics.snapshot())
+        examples = int(delta["counters"].get("fleet.examplesTrained", 0))
+        return models, {
+            "fleetSize": size,
+            "wallMs": wall * 1000.0,
+            "modelsPerSecond": size / wall,
+            "trainedExamplesPerSec": examples / wall,
+            "dispatchCount": int(
+                delta["timers"].get("iteration.dispatch", {}).get("count", 0)
+            ),
+            "hostSyncCount": int(delta["counters"].get("iteration.host_sync", 0)),
+            "wholeFitFleetCount": int(
+                delta["counters"].get("dispatch.whole_fit.fleet", 0)
+            ),
+        }
+
+    # the gate point: N=32 when swept, else the largest size that runs —
+    # so smoke-scale sweeps still pin the bit-identity contract in-process
+    gate_size = (
+        32
+        if 32 in fleet_sizes
+        else max((s for s in fleet_sizes if s <= 32), default=min(fleet_sizes))
+    )
+    by_size = {}
+    gate_models = None
+    for size in fleet_sizes:
+        if size > 32 and not in_budget():
+            log(f"fleetSweep: skipping N={size} (budget)")
+            continue
+        models, point = run(size)
+        by_size[str(size)] = point
+        if size == gate_size:
+            gate_models = models
+        log(
+            f"fleetSweep N={size}: {point['modelsPerSecond']:.1f} models/s, "
+            f"{point['trainedExamplesPerSec']:.3g} examples/s, "
+            f"{point['dispatchCount']} dispatch / {point['hostSyncCount']} sync "
+            f"in {point['wallMs']:.0f}ms"
+        )
+
+    gate = by_size[str(gate_size)]
+    assert gate["dispatchCount"] == 1, (
+        f"fleet fit paid {gate['dispatchCount']} dispatches, expected 1"
+    )
+    assert gate["hostSyncCount"] == 1, (
+        f"fleet fit paid {gate['hostSyncCount']} host syncs, expected 1"
+    )
+    if gate_models is not None:
+        # every member vs its solo whole-fit run — bit-identical
+        for i, model in enumerate(gate_models):
+            solo = member(i, gate_size).fit(table)
+            assert np.array_equal(
+                np.asarray(model.coefficient), np.asarray(solo.coefficient)
+            ), f"fleet member {i} diverged from its solo fit"
+
+    result = {
+        "inputRecordNum": n,
+        "dim": d,
+        "maxIter": max_iter,
+        # gated leaves: the N=32 amortization point (lower-better counts,
+        # higher-better throughputs — bench_diff direction rules)
+        "dispatchCount": gate["dispatchCount"],
+        "hostSyncCount": gate["hostSyncCount"],
+        "wallMs": gate["wallMs"],
+        "modelsPerSecond": gate["modelsPerSecond"],
+        "trainedExamplesPerSec": gate["trainedExamplesPerSec"],
+        "bitIdenticalToSolo": gate_models is not None,  # asserted above
+        "byFleetSize": by_size,
+    }
+    if "1" in by_size and "32" in by_size:
+        # the headline amortization ratio: models/s lift of batching 32
+        # fits into one program vs training them one at a time
+        result["modelsPerSecondLift32"] = (
+            by_size["32"]["modelsPerSecond"] / by_size["1"]["modelsPerSecond"]
+        )
+    return result
+
+
 def bench_checkpoint_resume(n=200_000, d=64, max_iter=24, kill_after_chunks=8):
     """The preemption-safety workload (ISSUE 6): dense SGD with JobSnapshot
     checkpointing every epoch. Reports (a) snapshot cost — wall delta per
@@ -1646,6 +1770,7 @@ def main(argv):
         "pipelineServing": None,
         "inputPipeline": None,
         "wholeFitDispatch": None,
+        "fleetSweep": None,
         "checkpointResume": None,
         "multiHostCheckpoint": None,
         "elasticRecovery": None,
@@ -1744,6 +1869,12 @@ def main(argv):
                 details["wholeFitDispatch"] = bench_whole_fit_dispatch()
             except Exception as e:
                 log(f"wholeFitDispatch stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["fleetSweep"] = bench_fleet_sweep(in_budget=in_budget)
+            except Exception as e:
+                log(f"fleetSweep stage failed: {e!r}")
 
         if in_budget():
             try:

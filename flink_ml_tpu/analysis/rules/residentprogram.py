@@ -72,9 +72,42 @@ def _is_callback_call(node: ast.Call, info, imports: Dict[str, tuple]) -> str:
     return ""
 
 
+def _is_vmap_call(node: ast.AST, info) -> bool:
+    """True for `jax.vmap(...)` / `vmap(...)` (any jax alias / direct
+    import) — a batching wrapper whose operand stays a resident body."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    root, _, rest = name.partition(".")
+    if root in info.jax_aliases and rest == "vmap":
+        return True
+    target = info.imports.get(root)
+    return (
+        target is not None
+        and rest == ""
+        and target[0].startswith("jax")
+        and target[1] == "vmap"
+    )
+
+
+def _unwrap_vmap_name(node: ast.AST, info) -> str:
+    """The function NAME under any stack of vmap wrappers (`jax.vmap(f)`,
+    `vmap(vmap(f))`, ...); '' when the operand is not a plain name.
+    vmap changes batching, not residency — a vmapped while_loop body is
+    still compiled into the one-dispatch program (fleet kernels)."""
+    while _is_vmap_call(node, info):
+        if not node.args:
+            return ""
+        node = node.args[0]
+    return node.id if isinstance(node, ast.Name) else ""
+
+
 def _loop_body_names(module: SourceModule, info) -> Set[str]:
     """Names of local functions passed positionally to a lax loop/branch
-    combinator (their bodies run inside the compiled program)."""
+    combinator (their bodies run inside the compiled program) — seen
+    through vmap wrappers (`lax.while_loop(vmap(cond), vmap(body), ...)`)."""
     names: Set[str] = set()
     for node in ast.walk(module.tree):
         if not isinstance(node, ast.Call):
@@ -93,13 +126,19 @@ def _loop_body_names(module: SourceModule, info) -> Set[str]:
         for arg in node.args:
             if isinstance(arg, ast.Name):
                 names.add(arg.id)
+            else:
+                unwrapped = _unwrap_vmap_name(arg, info)
+                if unwrapped:
+                    names.add(unwrapped)
     return names
 
 
 def _kernel_impl_names(module: SourceModule, info) -> Set[str]:
     """Function names whose defs ARE jitted-kernel bodies: decorated defs
     plus the first positional argument of a `NAME = lazy_jit(impl, ...)` /
-    `jax.jit(impl, ...)` module-level binding."""
+    `jax.jit(impl, ...)` module-level binding — including a vmap-wrapped
+    impl (`NAME = lazy_jit(jax.vmap(impl), ...)`, the fleet-kernel
+    idiom)."""
     names: Set[str] = set(info.kernels)
     for node in module.tree.body:
         if (
@@ -109,9 +148,14 @@ def _kernel_impl_names(module: SourceModule, info) -> Set[str]:
             and node.targets[0].id in info.kernels
             and isinstance(node.value, ast.Call)
             and node.value.args
-            and isinstance(node.value.args[0], ast.Name)
         ):
-            names.add(node.value.args[0].id)
+            arg0 = node.value.args[0]
+            if isinstance(arg0, ast.Name):
+                names.add(arg0.id)
+            else:
+                unwrapped = _unwrap_vmap_name(arg0, info)
+                if unwrapped:
+                    names.add(unwrapped)
     return names
 
 

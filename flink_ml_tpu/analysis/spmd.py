@@ -1157,6 +1157,20 @@ def _find_def(scopes: List[ast.AST], name: str) -> Optional[ast.FunctionDef]:
     return None
 
 
+def _unwrap_vmap_expr(expr: Optional[ast.AST]) -> Optional[ast.AST]:
+    """Peel `jax.vmap(f)` / `vmap(vmap(f))` wrappers off a function
+    expression: vmap adds a batch axis but the wrapped body is still the
+    shard_map body whose reductions the specs must match (the fleet
+    kernels shard_map vmapped member programs)."""
+    while (
+        isinstance(expr, ast.Call)
+        and (dotted_name(expr.func) or "").split(".")[-1] == "vmap"
+        and expr.args
+    ):
+        expr = expr.args[0]
+    return expr
+
+
 def find_shard_map_sites(ctx: ModuleContext) -> List[ShardMapSite]:
     module = ctx.module
     sites: List[ShardMapSite] = []
@@ -1196,6 +1210,7 @@ def find_shard_map_sites(ctx: ModuleContext) -> List[ShardMapSite]:
                 elif kw.arg == "out_specs":
                     out_expr = kw.value
         fn_def = None
+        fn_expr = _unwrap_vmap_expr(fn_expr)
         if isinstance(fn_expr, ast.Name):
             fn_def = _find_def(scopes, fn_expr.id)
         if fn_def is None:

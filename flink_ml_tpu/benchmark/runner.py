@@ -174,6 +174,22 @@ def run_benchmark(name: str, entry: Dict) -> Dict:
         "wholeFitFallbacks": int(
             delta["counters"].get("dispatch.whole_fit_fallback", 0)
         ),
+        # fleet-training evidence (fleet.py): members this entry trained
+        # through the vmapped resident program, and the many-model
+        # throughput those fits amortized into the work phases —
+        # modelsPerSecond at fleetSize=1 IS the solo fit rate, so a drop
+        # at constant fleetSize between BENCH files is a fleet regression
+        "fleetSize": (
+            int(delta["gauges"].get("fleet.size", 0))
+            if delta["counters"].get("fleet.modelsTrained", 0)
+            else 0
+        ),
+        "modelsPerSecond": (
+            delta["counters"].get("fleet.modelsTrained", 0)
+            / (work_ms / 1000.0)
+            if work_ms and delta["counters"].get("fleet.modelsTrained", 0)
+            else 0.0
+        ),
         "hostDispatchMs": host_dispatch_ms,
         "dispatchGapMs": (
             max(0.0, work_ms - host_dispatch_ms) if gap_count else 0.0

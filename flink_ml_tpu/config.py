@@ -73,6 +73,29 @@ if os.environ.get("FLINK_ML_TPU_WHOLE_FIT") in ("auto", "off"):
     whole_fit = os.environ["FLINK_ML_TPU_WHOLE_FIT"]
 
 
+# --- fleet training (fleet.py) ------------------------------------------------
+# A FitFleet shards its member (fleet) axis over the mesh data axis —
+# replicating the training data instead — once the per-member state total
+# (N x carry bytes) crosses this threshold AND the fleet divides the data
+# shards evenly (mesh.fleet_axis_shardable). Below it, member state is
+# replicated like any other model state and the data stays data-sharded.
+# None disables automatic fleet sharding (FitFleet(shard_fleet_axis=True)
+# still forces it).
+fleet_shard_state_bytes: Optional[int] = 256 << 20
+
+
+@contextmanager
+def fleet_shard_threshold(nbytes: Optional[int]):
+    """Scoped override of `fleet_shard_state_bytes` (None = never auto)."""
+    global fleet_shard_state_bytes
+    prev = fleet_shard_state_bytes
+    fleet_shard_state_bytes = nbytes
+    try:
+        yield
+    finally:
+        fleet_shard_state_bytes = prev
+
+
 # --- Pallas sparse kernels (ops/sparsekernels.py) -----------------------------
 # Route the sparse padded-CSR gradient path (masked gather row-dots + the
 # segment-sum scatter XLA lowers poorly) through hand-written Pallas

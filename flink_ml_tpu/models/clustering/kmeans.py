@@ -83,7 +83,11 @@ def _lloyd_train_impl(X, weights, init_centroids, max_iter, measure_name):
         one_hot = jax.nn.one_hot(assign, centroids.shape[0], dtype=X.dtype)  # (n, k)
         one_hot = one_hot * weights[:, None]
         counts = jnp.sum(one_hot, axis=0)  # (k,)
-        sums = one_hot.T @ X  # (k, d) — MXU matmul doubling as segment-sum
+        # reduce form rather than `one_hot.T @ X`: the matmat's blocked
+        # accumulation over n changes under vmap batching, which would break
+        # the fleet contract (every fleet member bit-identical to its solo
+        # fit — see ops/losses.py module docstring and fleet.py)
+        sums = jnp.sum(one_hot[:, :, None] * X[:, None, :], axis=0)  # (k, d)
         new_centroids = jnp.where(
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1e-30), centroids
         )
@@ -101,6 +105,32 @@ _lloyd_train = lazy_jit(_lloyd_train_impl, static_argnames=("measure_name",))
 # second copy for the duration of the fit.
 _lloyd_train_donating = lazy_jit(
     _lloyd_train_impl, static_argnames=("measure_name",), donate_argnums=(0, 1, 2)
+)
+
+
+def _lloyd_fleet_train_impl(X, weights, init_centroids, max_iters, measure_name, pack_sharding):
+    """N Lloyd fits as ONE vmapped resident program (fleet.py): the member
+    loop is `_lloyd_train_impl` verbatim, vmapped over the per-member
+    (init_centroids[N,k,d], max_iters[N]) with the staged dataset closed
+    over unbatched — input bytes are paid once for N models. The vmapped
+    `while_loop` runs until every member hits its own maxIter and
+    select-freezes finished members, and every contraction in the body is
+    vmap-batching bit-stable (see `_lloyd_train_impl`), so each member's
+    centroids are bit-identical to its solo fit. Readback is ONE packed
+    [N, k*d + k] array ([centroids.ravel | counts] per member)."""
+    def member(c0, mi):
+        return _lloyd_train_impl(X, weights, c0, mi, measure_name)
+
+    centroids, counts = jax.vmap(member)(init_centroids, max_iters)
+    n_members, k, d = init_centroids.shape
+    packed = jnp.concatenate([centroids.reshape(n_members, k * d), counts], axis=1)
+    if pack_sharding is not None:
+        packed = jax.lax.with_sharding_constraint(packed, pack_sharding)
+    return packed
+
+
+_lloyd_fleet_train = lazy_jit(
+    _lloyd_fleet_train_impl, static_argnames=("measure_name", "pack_sharding")
 )
 
 

@@ -86,6 +86,7 @@ __all__ = [
     "mark_peak",
     "peak_since",
     "fit_peak_scope",
+    "record_fleet_fit_peak",
     "snapshot",
     "ranked_entries",
     "dump_snapshot",
@@ -101,6 +102,7 @@ CATEGORIES = (
     "batchCache",
     "streamSegments",
     "serving",
+    "fleet",
     "scratch",
 )
 
@@ -369,18 +371,49 @@ def peak_since(token: int, close: bool = True) -> int:
         return value
 
 
+#: Gauge-cardinality cap for per-member fleet peak gauges: fleets larger
+#: than this record only the first _FLEET_MEMBER_GAUGE_CAP member gauges
+#: (the aggregate `hbm.peak.fit` always lands regardless).
+_FLEET_MEMBER_GAUGE_CAP = 64
+
+
 class fit_peak_scope:
     """Context manager bracketing one fit: on exit, the peak live bytes
     observed inside the scope land on the `hbm.peak.fit` gauge (the
-    per-fit watermark next to the global `hbm.peak`)."""
+    per-fit watermark next to the global `hbm.peak`).
+
+    `member` namespaces the watermark per fleet member index
+    (`hbm.peak.fit.member.<i>`) so peaks inside a FitFleet are
+    attributable to the member whose state was in flight — a bare
+    `hbm.peak.fit` keyed per stage-fit would attribute every member's
+    staging to whichever fit ran last. The aggregate gauge still lands
+    so dashboards keyed on it see fleet fits too."""
+
+    def __init__(self, member: Optional[int] = None):
+        self._member = member
 
     def __enter__(self):
         self._tok = mark_peak()
         return self
 
     def __exit__(self, *exc):
-        metrics.set_gauge("hbm.peak.fit", peak_since(self._tok))
+        peak = peak_since(self._tok)
+        metrics.set_gauge("hbm.peak.fit", peak)
+        if self._member is not None and self._member < _FLEET_MEMBER_GAUGE_CAP:
+            metrics.set_gauge(f"hbm.peak.fit.member.{self._member}", peak)
         return False
+
+
+def record_fleet_fit_peak(peak: int, num_members: int) -> None:
+    """Attribute one fleet program's peak to every member that rode it.
+
+    The fleet fit is ONE resident program — all N members share a single
+    HBM watermark — so the honest per-member attribution is that same
+    watermark on each member's gauge (capped at `_FLEET_MEMBER_GAUGE_CAP`
+    members to bound gauge cardinality)."""
+    metrics.set_gauge("hbm.peak.fit", peak)
+    for i in range(min(num_members, _FLEET_MEMBER_GAUGE_CAP)):
+        metrics.set_gauge(f"hbm.peak.fit.member.{i}", peak)
 
 
 # ---------------------------------------------------------------------------

@@ -5,9 +5,21 @@ The reference computes per-sample loss/gradient with scalar BLAS calls
 LeastSquareLoss.java, LossFunc.java). Here each loss is a *batched* pure
 function over (X[B,d], y[B], w[B], coeff[d]) returning
 (loss_sum, grad_sum[d], weight_sum): the per-sample dot products become one
-X @ coeff matvec and the gradient accumulation one X.T @ multiplier matvec
-— both MXU matmuls. Formulas match the reference exactly (labels in {0,1},
+batched row contraction and the gradient accumulation one batched column
+reduction. Formulas match the reference exactly (labels in {0,1},
 scaled to ±1 internally) so training losses are comparable.
+
+The dense contractions are written as broadcast-multiply + `jnp.sum`
+(`dense_dot` / `dense_grad`) rather than `X @ coeff` / `X.T @ mult`
+matvecs on purpose: a gemv and the gemm it becomes under `jax.vmap`
+batching accumulate the contraction dimension in different orders on the
+CPU backend (1–2 ULP drift for d >= 8), which would break the fleet
+training contract — every fleet member bit-identical to its solo fit
+(fleet.py, pinned by tests/test_fleet.py). The reduce form lowers to the
+same per-row accumulation order whether or not a leading batch dimension
+is present, so solo and vmapped fits share bits. XLA fuses the
+multiply into the reduction, and on TPU the reduce form is rewritten to
+the MXU anyway, so the hot path does not regress.
 """
 
 from __future__ import annotations
@@ -62,12 +74,28 @@ def _least_square_pointwise(dot, y, w):
     return loss, multiplier
 
 
+def dense_dot(X, coeff):
+    """Per-row dot products X[B,d] · coeff[d] -> [B], in the
+    vmap-batching-stable reduce form (see module docstring). Every dense
+    training-path dot MUST go through this helper (or `dense_grad`) —
+    mixing it with a `X @ coeff` matvec in a parity-coupled path
+    reintroduces the gemv/gemm accumulation split."""
+    return jnp.sum(X * coeff, axis=-1)
+
+
+def dense_grad(X, multiplier):
+    """Gradient accumulation sum_B multiplier[B] * X[B,d] -> [d], the
+    reduce-form twin of `dense_dot` (same vmap-stability contract)."""
+    return jnp.sum(X * multiplier[..., None], axis=-2)
+
+
 def _dense(pointwise):
-    """Dense batched loss: dot/grad are MXU matmuls over (B, d) X."""
+    """Dense batched loss over (B, d) X; contractions via the
+    vmap-stable `dense_dot`/`dense_grad` forms."""
 
     def fn(X, y, w, coeff) -> LossOut:
-        loss, multiplier = pointwise(X @ coeff, y, w)
-        return jnp.sum(loss), X.T @ multiplier, jnp.sum(w)
+        loss, multiplier = pointwise(dense_dot(X, coeff), y, w)
+        return jnp.sum(loss), dense_grad(X, multiplier), jnp.sum(w)
 
     return fn
 

@@ -453,6 +453,199 @@ _sgd_stream_whole_fit = lazy_jit(
 )
 
 
+# ---------------------------------------------------------------------------
+# fleet kernels: N whole fits as ONE vmapped resident program (fleet.py)
+# ---------------------------------------------------------------------------
+#
+# The fleet programs vmap the member fit over a leading fleet axis: the
+# batched data (X_b, y_b, w_b) is CLOSED OVER (in_axes=None — input bytes
+# are paid once for N models) while the carry leaves, criteria, and the
+# packed hyper vector ([N, 5] — every member carries its own
+# maxIter/tol/lr/reg/elasticNet) batch over members. JAX's `while_loop`
+# batching rule runs the loop until every member's condition is false and
+# select-freezes finished members' carries — exactly the per-member
+# convergence-mask contract, and (pinned by tests/test_fleet.py) each
+# member's result is bit-identical to its solo fit on the same mesh.
+#
+# `lax.optimization_barrier` has NO batching rule, so the final-update
+# barrier of `_sgd_whole_fit_impl` must be applied OUTSIDE the vmap, on
+# the stacked carry: one barrier pins every member's loop carry at once,
+# preserving the update-not-fused-into-the-loop-epilogue guarantee that
+# makes whole-fit results match the chunked path's host-side
+# `_final_update` bitwise.
+
+
+def _fleet_member_finish(carry, criteria, hyper, dtype, flag):
+    """One member's post-loop tail: the one-extra model update + the
+    per-member result row [flag?, coeff, criteria, epochs]. vmapped by the
+    fleet kernels (no per-part pack_sharding here — the stacked
+    [N, pack] result is constrained once, outside the vmap)."""
+    _, _, lr, reg, elastic_net = _unpack_hyper(hyper, dtype)
+    coeff, grad, wsum, epochs = carry
+    final_coeff = _update_model(coeff, grad, wsum, lr, reg, elastic_net)
+    return _pack_train_result(final_coeff, criteria, epochs, flag)
+
+
+def _sgd_fleet_whole_fit_impl(
+    X_b, y_b, w_b, carry, criteria, loss_func, hyper, check_labels, pack_sharding
+):
+    """N ENTIRE fits as ONE resident program: every member runs
+    `_sgd_chunk_impl` to its own maxIter (per-epoch tol check inside the
+    vmapped while condition — identical stop epoch to its solo fit), the
+    stacked carry is barrier-pinned, and the vmapped finish packs the
+    [N, flag? + d + 2] result for a single fleet readback. The {0,1}
+    label-validity flag is computed ONCE outside the vmap (labels are
+    shared) and broadcast into every member's row."""
+    dtype = _feature_dtype(X_b)
+
+    def member_loop(c, crit, h):
+        member_max_iter = _unpack_hyper(h, dtype)[0]
+        c, crit, _ = _sgd_chunk_impl(
+            X_b, y_b, w_b, c, crit, loss_func, h, member_max_iter
+        )
+        return c, crit
+
+    carry, criteria = jax.vmap(member_loop)(carry, criteria, hyper)
+    carry = lax.optimization_barrier(carry)
+    flag = _binomial_labels_ok(y_b) if check_labels else None
+
+    def member_finish(c, crit, h):
+        return _fleet_member_finish(c, crit, h, dtype, flag)
+
+    packed = jax.vmap(member_finish)(carry, criteria, hyper)
+    if pack_sharding is not None:
+        packed = lax.with_sharding_constraint(packed, pack_sharding)
+    return carry, criteria, packed
+
+
+_sgd_fleet_whole_fit = lazy_jit(
+    _sgd_fleet_whole_fit_impl,
+    static_argnames=("loss_func", "check_labels", "pack_sharding"),
+)
+
+
+def _sgd_fleet_chunk_impl(X_b, y_b, w_b, carry, criteria, loss_func, hyper, chunk_end):
+    """The fleet chunk for the checkpointed train loop: every member runs
+    `_sgd_chunk_impl` to min(chunk_end, its own maxIter) — a member whose
+    budget ends inside the chunk freezes there, matching its solo stop
+    epoch for any chunk size. Returns (carry, criteria, packed [N, 2])
+    where each row is the member's (epoch, criteria) drain pair."""
+    dtype = _feature_dtype(X_b)
+
+    def member(c, crit, h):
+        member_end = jnp.minimum(
+            jnp.asarray(chunk_end, jnp.int32), _unpack_hyper(h, dtype)[0]
+        )
+        return _sgd_chunk_impl(X_b, y_b, w_b, c, crit, loss_func, h, member_end)
+
+    return jax.vmap(member)(carry, criteria, hyper)
+
+
+_sgd_fleet_chunk = lazy_jit(_sgd_fleet_chunk_impl, static_argnames=("loss_func",))
+
+
+def _sgd_fleet_final_impl(carry, criteria, hyper, pack_sharding):
+    """The fleet chunked path's finish as its own program (the dispatch
+    boundary is the barrier here, exactly like the solo `_final_update`):
+    vmapped one-extra update + result pack → [N, d + 2]."""
+    dtype = carry[0].dtype
+
+    def member(c, crit, h):
+        return _fleet_member_finish(c, crit, h, dtype, None)
+
+    packed = jax.vmap(member)(carry, criteria, hyper)
+    if pack_sharding is not None:
+        packed = lax.with_sharding_constraint(packed, pack_sharding)
+    return packed
+
+
+_sgd_fleet_final = lazy_jit(_sgd_fleet_final_impl, static_argnames=("pack_sharding",))
+
+
+def _sgd_fleet_stream_whole_fit_impl(
+    packed_all, carry, criteria, loss_func, hyper, d, pack_sharding
+):
+    """N out-of-core fits as ONE resident program over the SHARED stacked
+    [X | y | w] segment array.
+
+    Unlike the dense fleet kernel this one keeps a GLOBAL epoch counter
+    and vmaps only the per-epoch member step: the in-loop
+    `optimization_barrier` that materializes the batch's column views (the
+    solo kernel's host-pipeline parity trick) has no batching rule, so the
+    batch must be sliced from an UNBATCHED index. That is loss-free:
+    members advance in lockstep while active (an active member's epoch
+    counter always equals the global counter — all start at 0 and step
+    once per outer iteration), and a stopped member's step is a `select`
+    identity, so each member still sees exactly its solo batch sequence.
+    Members past their own maxIter freeze via `lax.cond` (vmap lowers it
+    to the convergence-mask select); `_stream_epoch_impl`'s criteria guard
+    freezes tol-converged members exactly as on the solo path."""
+    dtype = _feature_dtype(packed_all)
+    nb = packed_all.shape[0]
+    max_iters = hyper[:, 0].astype(jnp.int32)
+    tols = hyper[:, 1]
+
+    def cond(state):
+        c, crit, _ = state
+        return jnp.any(jnp.logical_and(c[3] < max_iters, crit > tols))
+
+    def step(state):
+        c, crit, e = state
+        batch = lax.dynamic_index_in_dim(packed_all, jnp.mod(e, nb), 0, False)
+        Xk, yk, wk = lax.optimization_barrier(
+            (batch[:, :d], batch[:, d], batch[:, d + 1])
+        )
+
+        def member(cm, critm, h):
+            member_max_iter = _unpack_hyper(h, dtype)[0]
+
+            def run(args):
+                c0, cr0 = args
+                c1, cr1, _ = _stream_epoch_impl(
+                    Xk, yk, wk, c0, cr0, loss_func, h
+                )
+                return c1, cr1
+
+            return lax.cond(cm[3] < member_max_iter, run, lambda a: a, (cm, critm))
+
+        c, crit = jax.vmap(member)(c, crit, hyper)
+        return c, crit, e + 1
+
+    carry, criteria, _ = lax.while_loop(
+        cond, step, (carry, criteria, jnp.asarray(0, jnp.int32))
+    )
+    carry = lax.optimization_barrier(carry)
+
+    def member_finish(c, crit, h):
+        return _fleet_member_finish(c, crit, h, dtype, None)
+
+    packed = jax.vmap(member_finish)(carry, criteria, hyper)
+    if pack_sharding is not None:
+        packed = lax.with_sharding_constraint(packed, pack_sharding)
+    return carry, criteria, packed
+
+
+_sgd_fleet_stream_whole_fit = lazy_jit(
+    _sgd_fleet_stream_whole_fit_impl,
+    static_argnames=("loss_func", "d", "pack_sharding"),
+)
+
+
+def unpack_fleet_train_result(host: np.ndarray, d: int, has_flag: bool = False):
+    """Host-side inverse of the fleet result pack ([N, flag? + d + 2] —
+    `_fleet_member_finish` rows): returns (flags_or_None, coeff [N, d],
+    criteria [N], epochs [N])."""
+    host = np.asarray(host)
+    off = 1 if has_flag else 0
+    flags = host[:, 0] if has_flag else None
+    return (
+        flags,
+        host[:, off : off + d],
+        host[:, -2],
+        host[:, -1].astype(np.int64),
+    )
+
+
 def unpack_train_result(host: np.ndarray, d: int, has_flag: bool = False):
     """Host-side inverse of `_pack_train_result`: returns
     (flag_or_None, coeff[:d], criteria, epochs)."""
@@ -1374,7 +1567,7 @@ class SGD:
         (coeff_h,) = packed_device_get(coeff, sync_kind="fit")
         return np.asarray(coeff_h), final_crit, final_epoch
 
-    def _batchify(self, mesh: Mesh, X, y, weights, d_pad=None):
+    def _batchify(self, mesh: Mesh, X, y, weights, d_pad=None, replicate_data=False):
         """Stage data into device-resident (num_batches, padded_batch, ...)
         arrays sharded over the data axis.
 
@@ -1382,11 +1575,19 @@ class SGD:
         cast is the only host copy, and only when needed); device-resident
         inputs (e.g. benchmark tables generated on chip) transfer nothing.
         All padding/reshaping happens on device (`_layout_batches`), and
-        absent weights are synthesized on device (`_default_weights`)."""
+        absent weights are synthesized on device (`_default_weights`).
+
+        `replicate_data` is the fleet-axis-sharded regime's layout
+        (fleet.py): the mesh data axis is spent on the FLEET dimension, so
+        the shared training data stays replicated and the batch layout is
+        computed as for a single data shard — which is why a
+        fleet-sharded member's fit is bit-identical to its solo fit on a
+        ONE-device mesh (docs/performance.md §11)."""
         n = int(np.shape(X[0] if isinstance(X, tuple) else X)[0])
         B = int(self.global_batch_size)
         num_batches = max(1, -(-n // B))
-        shards = mesh_lib.num_data_shards(mesh)
+        data_axis = None if replicate_data else mesh_lib.DATA_AXIS
+        shards = 1 if replicate_data else mesh_lib.num_data_shards(mesh)
         b_pad = -(-B // shards) * shards
 
         def stage(arr, dtype=None):
@@ -1404,7 +1605,7 @@ class SGD:
             arr = np.asarray(arr)
             if arr.dtype != dtype:
                 arr = arr.astype(dtype)
-            spec = P(mesh_lib.DATA_AXIS, *([None] * (arr.ndim - 1)))
+            spec = P(data_axis, *([None] * (arr.ndim - 1)))
             sharding = NamedSharding(mesh, spec)
             rows = arr.shape[0]
             if shards == 1 or rows % shards == 0:
@@ -1439,7 +1640,7 @@ class SGD:
             # sparse padded-CSR: neither leaf has a feature axis to shard —
             # indices reference the (possibly model-sharded) coefficient;
             # XLA inserts the gather/scatter collectives for the TP layout
-            csr_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS, None))
+            csr_sharding = NamedSharding(mesh, P(None, data_axis, None))
             X_b = (
                 layout(stage(X[0], np.int32), n, num_batches, B, b_pad, None, csr_sharding),
                 layout(stage(X[1]), n, num_batches, B, b_pad, None, csr_sharding),
@@ -1454,12 +1655,12 @@ class SGD:
                 d_pad,
                 NamedSharding(
                     mesh,
-                    P(None, mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+                    P(None, data_axis, mesh_lib.MODEL_AXIS)
                     if d_pad is not None
-                    else P(None, mesh_lib.DATA_AXIS, None),
+                    else P(None, data_axis, None),
                 ),
             )
-        row_sharding = NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS))
+        row_sharding = NamedSharding(mesh, P(None, data_axis))
         y_b = layout(stage(y), n, num_batches, B, b_pad, None, row_sharding)
         if weights is None:
             # Padding rows get weight 0: they contribute nothing to

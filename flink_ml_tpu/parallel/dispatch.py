@@ -103,7 +103,10 @@ def whole_fit_enabled() -> bool:
 
 def account_whole_fit(kind: str = "fit") -> None:
     """Count a fit taking the resident-program path (`dispatch.whole_fit`
-    + a per-loop kind: sgd / stream / lloyd / iterate)."""
+    + a per-loop kind: sgd / stream / lloyd / iterate / fleet — `fleet`
+    counts ONE for the whole N-member vmapped program, which is the
+    point: `fleet.modelsTrained` / `dispatch.whole_fit.fleet` is the
+    amortization ratio)."""
     metrics.inc_counter("dispatch.whole_fit")
     metrics.inc_counter(f"dispatch.whole_fit.{kind}")
 

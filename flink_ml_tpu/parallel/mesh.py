@@ -163,6 +163,31 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def fleet_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading FLEET axis of per-member state ([N, ...] carries,
+    [N, pack] hypers/results) over the mesh data axis.
+
+    The fleet-sharded regime (fleet.py) inverts the usual layout: when
+    N x per-member state exceeds one device, the fleet axis rides the
+    `data` mesh axis — each device owns N/shards whole members — and the
+    training DATA is replicated instead (each member still sees every
+    example, so member math is untouched and solo-fit bit-parity holds).
+    The spec is identical to `data_sharding`; the distinct helper exists
+    because the two axes mean different things: a reduce over `data` in
+    the fleet regime would SUM ACROSS MEMBERS, which no fleet kernel may
+    ever emit."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def fleet_axis_shardable(mesh: Mesh, fleet_size: int) -> bool:
+    """Whether a fleet of `fleet_size` members can shard its member axis
+    over this mesh's data axis: the axis must exist with >1 shards and
+    divide the fleet evenly (ragged member shards would force padded
+    members whose dead lanes still burn flops in every vmapped epoch)."""
+    shards = num_data_shards(mesh)
+    return shards > 1 and fleet_size % shards == 0
+
+
 def pad_to_multiple(array, multiple: int, axis: int = 0, pad_value=0):
     """Pad `axis` up to a multiple so it divides evenly across shards.
 

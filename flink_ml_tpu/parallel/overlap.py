@@ -89,8 +89,10 @@ def _local_pieces(X, y, w, coeff, loss_func, sparse_pairs: bool):
                 jnp.zeros_like(coeff).at[safe].add(contrib, mode="drop")
             )
     else:
-        loss, mult = loss_func.pointwise(X @ coeff, y, w)
-        grad_local = X.T @ mult
+        from ..ops.losses import dense_dot, dense_grad
+
+        loss, mult = loss_func.pointwise(dense_dot(X, coeff), y, w)
+        grad_local = dense_grad(X, mult)
     return jnp.sum(loss), grad_local, jnp.sum(w)
 
 
@@ -408,7 +410,10 @@ def _build_lloyd_program(mesh: Mesh, measure_name: str):
             dists = measure.pairwise(X, centroids)
             assign = jnp.argmin(dists, axis=1)
             one_hot = jax.nn.one_hot(assign, k, dtype=X.dtype) * weights[:, None]
-            return (centroids, one_hot.T @ X, jnp.sum(one_hot, axis=0), epoch + 1)
+            # reduce-form segment sum, matching kmeans._lloyd_train_impl
+            # (vmap-batching bit-stability — see ops/losses.py docstring)
+            sums = jnp.sum(one_hot[:, :, None] * X[:, None, :], axis=0)
+            return (centroids, sums, jnp.sum(one_hot, axis=0), epoch + 1)
 
         init = (
             init_centroids,
@@ -425,3 +430,16 @@ def _build_lloyd_program(mesh: Mesh, measure_name: str):
     )
     # tpulint: disable=retrace-hazard -- overlap mode builds one program per fit by design (opt-in; caching keyed on mesh/shape is ROADMAP item 2)
     return jax.jit(mapped)
+
+
+def fleet_overlap_supported() -> bool:
+    """Whether fleet training (fleet.py) can ride the overlap-scheduled
+    shard_map programs. Currently False: the overlap programs are built
+    per-mesh-shard with `shard_map`, and vmapping a shard_map body over a
+    fleet axis would batch the deferred-reduction carry — the exact
+    cross-epoch pipelining the scheme relies on — per member, which XLA
+    re-serializes. A FitFleet therefore always trains on the plain
+    vmapped resident kernels and counts the downgrade under
+    `dispatch.whole_fit_fallback.fleet_overlap` so an overlap-tuned
+    deployment notices fleet fits leaving the overlap path."""
+    return False

@@ -90,6 +90,7 @@ class TestRunner:
             "outputRecordNum", "outputThroughput", "phaseTimesMs", "metrics",
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
             "wholeFitCount", "wholeFitFallbacks",
+            "fleetSize", "modelsPerSecond",
             "hostDispatchMs", "dispatchGapMs", "gapCount", "dispatchAttribution",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
@@ -100,6 +101,9 @@ class TestRunner:
         # the HBM ledger fields: a KMeans fit stages centroids/batches
         # through the accounted funnels, so the peak watermark is nonzero
         # and the published model constants are resident after transform
+        # fleet fields stay zero for a solo (non-fleet) fit
+        assert result["fleetSize"] == 0
+        assert result["modelsPerSecond"] == 0.0
         assert result["peakHbmBytes"] > 0
         assert 0 <= result["residentModelBytes"] <= result["peakHbmBytes"]
         assert result["hostSyncCount"] >= 1  # the packed fit readback

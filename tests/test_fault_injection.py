@@ -845,3 +845,108 @@ def test_mh_kmeans_out_of_core_kill_mid_commit_resume_bit_identical(tmp_path):
         resumed = fit()
     np.testing.assert_array_equal(resumed.centroids, full.centroids)
     np.testing.assert_array_equal(resumed.weights, full.weights)
+
+
+# ---------------------------------------------------------------------------
+# fleet x fault matrix (fleet.py): a kill mid-fleet-fit resumes from the
+# ONE fleet-axis snapshot cut and every member lands on the unkilled
+# fleet's exact coefficients — across chunk-boundary and snapshot-commit
+# kill sites, in both the replicated and the fleet-axis-sharded regime
+# ---------------------------------------------------------------------------
+
+def _fleet_lr_makers():
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+
+    def lr(max_iter, rate):
+        return (
+            LogisticRegression().set_max_iter(max_iter).set_tol(0.0)
+            .set_learning_rate(rate).set_global_batch_size(96)
+        )
+
+    return [
+        lambda: lr(10, 0.1),
+        lambda: lr(10, 0.02),
+        lambda: lr(5, 0.2),  # converged member frozen across the kill
+    ]
+
+
+@pytest.mark.parametrize("kill_after", [1, 2])
+def test_fleet_kill_at_chunk_boundary_resume_bit_identical(tmp_path, kill_after):
+    from flink_ml_tpu.fleet import FitFleet
+
+    X, y = _dense_problem(seed=31)
+    table = Table({"features": X, "label": y})
+    makers = _fleet_lr_makers()
+    expected = FitFleet([m() for m in makers]).fit(table)
+
+    with config.iteration_checkpointing(str(tmp_path / "fleet"), interval=3):
+        with faults.inject("chunk", after=kill_after) as plan:
+            with pytest.raises(InjectedFault):
+                FitFleet([m() for m in makers]).fit(table)
+        assert plan.fired
+        resumed = FitFleet([m() for m in makers]).fit(table)
+    for got, want in zip(resumed, expected):
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficient), np.asarray(want.coefficient)
+        )
+
+
+def test_fleet_kill_mid_snapshot_commit_resume_bit_identical(tmp_path):
+    """The kill lands INSIDE the multi-host manifest commit of a fleet
+    cut: the torn cut must be invisible on resume (restart from the last
+    durable cut)."""
+    from flink_ml_tpu.fleet import FitFleet
+
+    X, y = _dense_problem(seed=32)
+    table = Table({"features": X, "label": y})
+    makers = _fleet_lr_makers()
+    expected = FitFleet([m() for m in makers]).fit(table)
+
+    with config.iteration_checkpointing(
+        str(tmp_path / "commit"), interval=3
+    ), config.snapshot_hosts_mode(4):
+        with faults.inject("snapshot.commit", after=2) as plan:
+            with pytest.raises(InjectedFault):
+                FitFleet([m() for m in makers]).fit(table)
+        assert plan.fired
+        resumed = FitFleet([m() for m in makers]).fit(table)
+    for got, want in zip(resumed, expected):
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficient), np.asarray(want.coefficient)
+        )
+
+
+def test_fleet_sharded_kill_resume_bit_identical(tmp_path):
+    """Fleet-axis-sharded regime: the snapshot cut is sharded over the
+    fleet axis (section tag `data`); a kill + resume must restore every
+    device's members losslessly — all 8 bit-identical to the unkilled
+    sharded fleet."""
+    from flink_ml_tpu.fleet import FitFleet
+    from flink_ml_tpu.models.classification.logisticregression import (
+        LogisticRegression,
+    )
+
+    X, y = _dense_problem(seed=33)
+    table = Table({"features": X, "label": y})
+
+    def makers():
+        return [
+            LogisticRegression().set_max_iter(8).set_tol(0.0)
+            .set_learning_rate(0.02 * (i + 1)).set_global_batch_size(96)
+            for i in range(8)
+        ]
+
+    expected = FitFleet(makers(), shard_fleet_axis=True).fit(table)
+
+    with config.iteration_checkpointing(str(tmp_path / "shard"), interval=3):
+        with faults.inject("chunk", after=1) as plan:
+            with pytest.raises(InjectedFault):
+                FitFleet(makers(), shard_fleet_axis=True).fit(table)
+        assert plan.fired
+        resumed = FitFleet(makers(), shard_fleet_axis=True).fit(table)
+    for got, want in zip(resumed, expected):
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficient), np.asarray(want.coefficient)
+        )

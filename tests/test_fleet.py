@@ -1,0 +1,485 @@
+"""FitFleet: N fits as ONE vmapped resident dispatch (fleet.py, the
+`_sgd_fleet_*` kernels in ops/optimizer.py, `_lloyd_fleet_train` in
+models/clustering/kmeans.py).
+
+The pinned contract (docs/performance.md §11):
+
+- every fleet member's fitted model is BIT-IDENTICAL to the model its
+  estimator would produce solo — dense/sparse SGD (all three losses),
+  stream SGD, and Lloyd, in both the replicated and the
+  fleet-axis-sharded regime;
+- an N-member fleet fit is ONE whole-fit dispatch and ONE blocking
+  host sync (`dispatch.whole_fit.fleet`, `iteration.host_sync.fit`);
+- the per-member convergence mask freezes early-stoppers at their solo
+  stop epoch while later members keep training;
+- checkpointed fleet fits cut ONE fleet-axis snapshot and resume onto
+  the uninterrupted run's exact final models;
+- the fleet winner promotes into a `ModelLifecycle` version ring through
+  the unchanged promotion gate.
+"""
+
+import numpy as np
+import pytest
+
+from flink_ml_tpu import config
+from flink_ml_tpu.fleet import FitFleet, fleet_model_arrays, promote_fleet_winner
+from flink_ml_tpu.models.classification.linearsvc import LinearSVC
+from flink_ml_tpu.models.classification.logisticregression import LogisticRegression
+from flink_ml_tpu.models.clustering.kmeans import KMeans
+from flink_ml_tpu.models.regression.linearregression import LinearRegression
+from flink_ml_tpu.table import StreamTable, Table
+from flink_ml_tpu.utils import metrics
+
+
+def _classif_data(n=344, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ np.linspace(1, -1, d) > 0).astype(np.float32)
+    return X, y
+
+
+def _regression_data(n=300, d=6, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ np.linspace(-1, 1, d)).astype(np.float32)
+    return X, y
+
+
+def _lr(max_iter=10, tol=0.0, lr=0.1, reg=0.0, en=0.0, gbs=86):
+    return (
+        LogisticRegression()
+        .set_max_iter(max_iter)
+        .set_tol(tol)
+        .set_learning_rate(lr)
+        .set_reg(reg)
+        .set_elastic_net(en)
+        .set_global_batch_size(gbs)
+    )
+
+
+def _fleet_counters():
+    snap = metrics.snapshot()
+    return {
+        "wholeFit": snap["counters"].get("dispatch.whole_fit", 0),
+        "wholeFitFleet": snap["counters"].get("dispatch.whole_fit.fleet", 0),
+        "hostSync": snap["counters"].get("iteration.host_sync", 0),
+        "hostSyncFit": snap["counters"].get("iteration.host_sync.fit", 0),
+        "models": snap["counters"].get("fleet.modelsTrained", 0),
+        "fits": snap["counters"].get("fleet.fits", 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# dispatch amortization: N fits, ONE dispatch, ONE sync
+# ---------------------------------------------------------------------------
+
+class TestFleetDispatch:
+    def test_lr_fleet_one_dispatch_one_sync_bit_identical(self, mesh8):
+        """The acceptance contract: a varied-hyper LR fleet trains in ONE
+        whole-fit dispatch + ONE blocking sync, each member bit-identical
+        to its solo fit (including an early tol-stopper and a shorter
+        maxIter member — the convergence mask at work)."""
+        X, y = _classif_data()
+        table = Table({"features": X, "label": y})
+        makers = [
+            lambda: _lr(max_iter=12, lr=0.1),
+            lambda: _lr(max_iter=12, lr=0.05, reg=0.1),
+            lambda: _lr(max_iter=5, lr=0.2),  # freezes 7 epochs early
+            lambda: _lr(max_iter=12, tol=0.5, lr=0.1),  # tol early-stop
+        ]
+        solo = [m().fit(table).coefficient for m in makers]
+
+        before = _fleet_counters()
+        models = FitFleet([m() for m in makers]).fit(table)
+        after = _fleet_counters()
+
+        assert after["wholeFit"] - before["wholeFit"] == 1
+        assert after["wholeFitFleet"] - before["wholeFitFleet"] == 1
+        assert after["hostSync"] - before["hostSync"] == 1
+        assert after["hostSyncFit"] - before["hostSyncFit"] == 1
+        assert after["models"] - before["models"] == 4
+        assert after["fits"] - before["fits"] == 1
+        assert metrics.snapshot()["gauges"].get("fleet.size") == 4
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_single_member_fleet(self, mesh8):
+        X, y = _classif_data(seed=5)
+        table = Table({"features": X, "label": y})
+        solo = _lr(max_iter=8).fit(table)
+        (model,) = FitFleet([_lr(max_iter=8)]).fit(table)
+        np.testing.assert_array_equal(
+            np.asarray(model.coefficient), np.asarray(solo.coefficient)
+        )
+
+    def test_member_peak_gauges_namespaced(self, mesh8):
+        X, y = _classif_data(seed=6)
+        table = Table({"features": X, "label": y})
+        FitFleet([_lr(max_iter=3), _lr(max_iter=4), _lr(max_iter=5)]).fit(table)
+        gauges = metrics.snapshot()["gauges"]
+        assert gauges.get("hbm.peak.fit", 0) > 0
+        for i in range(3):
+            assert gauges.get(f"hbm.peak.fit.member.{i}", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# solo-fit bit-parity across estimators and data paths
+# ---------------------------------------------------------------------------
+
+class TestFleetParity:
+    def test_linearsvc_fleet_parity(self, mesh8):
+        X, y = _classif_data(seed=2)
+        table = Table({"features": X, "label": y})
+        makers = [
+            lambda: LinearSVC().set_max_iter(9).set_global_batch_size(86),
+            lambda: LinearSVC().set_max_iter(9).set_reg(0.05).set_global_batch_size(86),
+            lambda: LinearSVC().set_max_iter(4).set_global_batch_size(86),
+        ]
+        solo = [m().fit(table).coefficient for m in makers]
+        models = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_linear_regression_fleet_parity(self, mesh8):
+        X, y = _regression_data()
+        table = Table({"features": X, "label": y})
+        makers = [
+            lambda: LinearRegression().set_max_iter(11).set_global_batch_size(75),
+            lambda: (
+                LinearRegression()
+                .set_max_iter(11)
+                .set_reg(0.1)
+                .set_elastic_net(0.5)
+                .set_global_batch_size(75)
+            ),
+        ]
+        solo = [m().fit(table).coefficient for m in makers]
+        models = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_weighted_fleet_parity(self, mesh8):
+        X, y = _classif_data(seed=3)
+        w = np.random.RandomState(4).rand(X.shape[0]).astype(np.float32)
+        table = Table({"features": X, "label": y, "weight": w})
+        makers = [
+            lambda: _lr(max_iter=7).set_weight_col("weight"),
+            lambda: _lr(max_iter=7, lr=0.3).set_weight_col("weight"),
+        ]
+        solo = [m().fit(table).coefficient for m in makers]
+        models = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_sparse_fleet_parity(self, mesh8):
+        """Padded-CSR sparse features ride the fleet program un-densified."""
+        from flink_ml_tpu.table import SparseVector
+
+        rng = np.random.RandomState(7)
+        n, dim, nnz = 256, 500, 6
+        rows, y = [], []
+        truth = rng.randn(dim).astype(np.float32)
+        for _ in range(n):
+            idx = np.sort(rng.choice(dim, size=nnz, replace=False))
+            val = rng.randn(nnz).astype(np.float32)
+            rows.append(SparseVector(dim, idx.astype(np.int64), val))
+            y.append(float(val @ truth[idx] > 0))
+        table = Table({"features": rows, "label": np.asarray(y, np.float32)})
+        makers = [
+            lambda: _lr(max_iter=6, gbs=64),
+            lambda: _lr(max_iter=6, lr=0.02, reg=0.01, gbs=64),
+            lambda: _lr(max_iter=3, gbs=64),
+        ]
+        solo = [m().fit(table).coefficient for m in makers]
+        models = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_stream_fleet_parity(self, mesh8):
+        """Out-of-core members: the stream's segments are staged ONCE and
+        the fleet trains in one `_sgd_fleet_stream_whole_fit` dispatch."""
+        X, y = _classif_data(n=320, seed=8)
+        batches = [
+            Table({"features": X[i : i + 80], "label": y[i : i + 80]})
+            for i in range(0, 320, 80)
+        ]
+        makers = [
+            lambda: _lr(max_iter=8, gbs=80),
+            lambda: _lr(max_iter=8, lr=0.02, gbs=80),
+            lambda: _lr(max_iter=4, gbs=80),
+        ]
+        solo = [
+            m().fit(StreamTable.from_batches(batches)).coefficient for m in makers
+        ]
+        before = _fleet_counters()
+        models = FitFleet([m() for m in makers]).fit(StreamTable.from_batches(batches))
+        after = _fleet_counters()
+        assert after["hostSync"] - before["hostSync"] == 1
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(np.asarray(got.coefficient), np.asarray(want))
+
+    def test_kmeans_fleet_parity(self, mesh8):
+        """N Lloyd fits (per-member seed/maxIter) == their solo fits,
+        centroids and weights bit-exact."""
+        rng = np.random.RandomState(9)
+        X = np.concatenate(
+            [rng.randn(60, 5).astype(np.float32) + c for c in (-4.0, 0.0, 4.0)]
+        )
+        table = Table({"features": X})
+        makers = [
+            lambda: KMeans().set_k(3).set_seed(11).set_max_iter(8),
+            lambda: KMeans().set_k(3).set_seed(29).set_max_iter(8),
+            lambda: KMeans().set_k(3).set_seed(11).set_max_iter(3),
+        ]
+        solo = [m().fit(table) for m in makers]
+        models = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(models, solo):
+            np.testing.assert_array_equal(
+                np.asarray(got.centroids), np.asarray(want.centroids)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.weights), np.asarray(want.weights)
+            )
+
+
+# ---------------------------------------------------------------------------
+# fleet-axis sharding: whole members per device, data replicated
+# ---------------------------------------------------------------------------
+
+class TestFleetSharding:
+    def test_forced_fleet_sharded_parity(self, mesh8):
+        """N=8 over 8 data shards: each device owns whole members over
+        REPLICATED data, so a member's reductions run in single-shard
+        order — the pinned contract is bit-identity to the member's solo
+        fit on ONE data shard (and allclose to any shard count; the same
+        across-mesh doctrine as elastic resume, docs/fault_tolerance.md)."""
+        import jax
+
+        from flink_ml_tpu.parallel import mesh as mesh_lib
+
+        X, y = _classif_data(seed=10)
+        table = Table({"features": X, "label": y})
+        makers = [lambda i=i: _lr(max_iter=6, lr=0.05 * (i + 1)) for i in range(8)]
+        solo8 = [m().fit(table).coefficient for m in makers]
+        mesh1 = mesh_lib.create_mesh(
+            (mesh_lib.DATA_AXIS,), devices=jax.devices()[:1]
+        )
+        with mesh_lib.use_mesh(mesh1):
+            solo1 = [m().fit(table).coefficient for m in makers]
+        models = FitFleet(
+            [m() for m in makers], shard_fleet_axis=True
+        ).fit(table)
+        assert metrics.snapshot()["gauges"].get("fleet.sharded") == 1.0
+        for got, bit_ref, close_ref in zip(models, solo1, solo8):
+            np.testing.assert_array_equal(
+                np.asarray(got.coefficient), np.asarray(bit_ref)
+            )
+            np.testing.assert_allclose(
+                np.asarray(got.coefficient), np.asarray(close_ref),
+                rtol=1e-5, atol=1e-6,
+            )
+
+    def test_auto_shard_threshold(self, mesh8):
+        """Crossing `config.fleet_shard_state_bytes` flips the regime
+        automatically; under it the fleet stays replicated."""
+        X, y = _classif_data(seed=11)
+        table = Table({"features": X, "label": y})
+        makers = [lambda i=i: _lr(max_iter=4, lr=0.1 + 0.01 * i) for i in range(8)]
+        with config.fleet_shard_threshold(1):  # 8*2*8*4 bytes >> 1
+            FitFleet([m() for m in makers]).fit(table)
+            assert metrics.snapshot()["gauges"].get("fleet.sharded") == 1.0
+        FitFleet([m() for m in makers]).fit(table)
+        assert metrics.snapshot()["gauges"].get("fleet.sharded") == 0.0
+
+    def test_forced_shard_indivisible_fleet_raises(self, mesh8):
+        X, y = _classif_data(seed=12)
+        with pytest.raises(ValueError, match="cannot shard"):
+            FitFleet(
+                [_lr(max_iter=3) for _ in range(3)], shard_fleet_axis=True
+            ).fit(Table({"features": X, "label": y}))
+
+    def test_sharded_kmeans_parity(self, mesh8):
+        """Fleet-sharded Lloyd: bit-identical to single-shard solo fits,
+        allclose to the 8-shard solo fits (reduction-order doctrine)."""
+        import jax
+
+        from flink_ml_tpu.parallel import mesh as mesh_lib
+
+        rng = np.random.RandomState(13)
+        X = np.concatenate(
+            [rng.randn(40, 4).astype(np.float32) + c for c in (-3.0, 3.0)]
+        )
+        table = Table({"features": X})
+        makers = [
+            lambda i=i: KMeans().set_k(2).set_seed(3 + i).set_max_iter(6)
+            for i in range(8)
+        ]
+        solo8 = [m().fit(table) for m in makers]
+        mesh1 = mesh_lib.create_mesh(
+            (mesh_lib.DATA_AXIS,), devices=jax.devices()[:1]
+        )
+        with mesh_lib.use_mesh(mesh1):
+            solo1 = [m().fit(table) for m in makers]
+        models = FitFleet([m() for m in makers], shard_fleet_axis=True).fit(table)
+        for got, bit_ref, close_ref in zip(models, solo1, solo8):
+            np.testing.assert_array_equal(
+                np.asarray(got.centroids), np.asarray(bit_ref.centroids)
+            )
+            np.testing.assert_allclose(
+                np.asarray(got.centroids), np.asarray(close_ref.centroids),
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+# ---------------------------------------------------------------------------
+# checkpointing: one fleet-axis cut, resume onto exact final models
+# ---------------------------------------------------------------------------
+
+class TestFleetCheckpointing:
+    def test_chunked_fleet_matches_whole(self, mesh8, tmp_path):
+        """A checkpoint cadence mid-fit forces the chunked fleet path;
+        its models must equal the uncheckpointed whole-fit fleet's."""
+        X, y = _classif_data(seed=14)
+        table = Table({"features": X, "label": y})
+        makers = [
+            lambda: _lr(max_iter=9),
+            lambda: _lr(max_iter=9, lr=0.05),
+            lambda: _lr(max_iter=4, lr=0.2),
+        ]
+        whole = FitFleet([m() for m in makers]).fit(table)
+        with config.iteration_checkpointing(str(tmp_path / "fleet"), interval=4):
+            chunked = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(chunked, whole):
+            np.testing.assert_array_equal(
+                np.asarray(got.coefficient), np.asarray(want.coefficient)
+            )
+
+    def test_resume_from_mid_fit_snapshot(self, mesh8, tmp_path):
+        """A fleet killed after its first snapshot resumes from the cut
+        and lands on the uninterrupted fleet's exact models."""
+        from flink_ml_tpu.ckpt import InjectedFault, faults
+
+        X, y = _classif_data(seed=15)
+        table = Table({"features": X, "label": y})
+        makers = [
+            lambda: _lr(max_iter=10),
+            lambda: _lr(max_iter=10, lr=0.02),
+            lambda: _lr(max_iter=6, lr=0.15),
+        ]
+        expected = FitFleet([m() for m in makers]).fit(table)
+        with config.iteration_checkpointing(str(tmp_path / "kill"), interval=3):
+            with faults.inject("chunk", after=2) as plan:
+                with pytest.raises(InjectedFault):
+                    FitFleet([m() for m in makers]).fit(table)
+            assert plan.fired
+            resumed = FitFleet([m() for m in makers]).fit(table)
+        for got, want in zip(resumed, expected):
+            np.testing.assert_array_equal(
+                np.asarray(got.coefficient), np.asarray(want.coefficient)
+            )
+
+
+# ---------------------------------------------------------------------------
+# construction / validation errors
+# ---------------------------------------------------------------------------
+
+class TestFleetValidation:
+    def test_empty_fleet_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            FitFleet([])
+
+    def test_mixed_classes_rejected(self):
+        with pytest.raises(ValueError, match="same estimator class"):
+            FitFleet([LogisticRegression(), LinearSVC()])
+
+    def test_unsupported_estimator_rejected(self):
+        from flink_ml_tpu.models.feature.standardscaler import StandardScaler
+
+        with pytest.raises(ValueError, match="does not support"):
+            FitFleet([StandardScaler()])
+
+    def test_structural_param_mismatch_rejected(self, mesh8):
+        X, y = _classif_data(seed=16)
+        table = Table({"features": X, "label": y})
+        fleet = FitFleet([_lr(gbs=32), _lr(gbs=64)])
+        with pytest.raises(ValueError, match="globalBatchSize"):
+            fleet.fit(table)
+
+    def test_multinomial_rejected(self, mesh8):
+        X, y = _classif_data(seed=17)
+        table = Table({"features": X, "label": y})
+        fleet = FitFleet([_lr(), _lr().set_multi_class("multinomial")])
+        with pytest.raises(ValueError, match="[Mm]ultinomial"):
+            fleet.fit(table)
+
+    def test_invalid_labels_rejected(self, mesh8):
+        X, _ = _classif_data(seed=18)
+        y = np.full(X.shape[0], 2.0, np.float32)
+        fleet = FitFleet([_lr(max_iter=2), _lr(max_iter=3)])
+        with pytest.raises(ValueError, match="binomial"):
+            fleet.fit(Table({"features": X, "label": y}))
+
+
+# ---------------------------------------------------------------------------
+# fleet -> lifecycle bridge: winner promotion
+# ---------------------------------------------------------------------------
+
+class TestWinnerPromotion:
+    def _serving_model(self, d):
+        from flink_ml_tpu.models.classification.onlinelogisticregression import (
+            OnlineLogisticRegressionModel,
+        )
+
+        m = OnlineLogisticRegressionModel()
+        m.publish_model_arrays((np.zeros(d, np.float32),), 0)
+        m.set_features_col("features").set_prediction_col("pred")
+        return m
+
+    def test_winner_promotes_into_version_ring(self, mesh8):
+        from flink_ml_tpu.lifecycle import ModelLifecycle
+
+        X, y = _classif_data(seed=19)
+        table = Table({"features": X, "label": y})
+        models = FitFleet(
+            [_lr(max_iter=6), _lr(max_iter=6, lr=0.02), _lr(max_iter=6, lr=0.3)]
+        ).fit(table)
+        scores = [0.71, 0.64, 0.83]
+        lc = ModelLifecycle(self._serving_model(X.shape[1]))
+        winner, version = promote_fleet_winner(lc, models, scores)
+        assert winner == 2
+        np.testing.assert_array_equal(
+            version.arrays[0], np.asarray(models[2].coefficient, np.float32)
+        )
+        assert lc.model.model_version == version.version_id
+        gauges = metrics.snapshot()["gauges"]
+        assert gauges.get("fleet.winnerIndex") == 2.0
+        assert gauges.get("fleet.winnerScore") == pytest.approx(0.83)
+
+    def test_min_mode_and_score_validation(self, mesh8):
+        from flink_ml_tpu.lifecycle import ModelLifecycle
+
+        X, y = _classif_data(seed=20)
+        models = FitFleet([_lr(max_iter=3), _lr(max_iter=4)]).fit(
+            Table({"features": X, "label": y})
+        )
+        lc = ModelLifecycle(self._serving_model(X.shape[1]))
+        winner, _ = promote_fleet_winner(lc, models, [0.4, 0.1], mode="min")
+        assert winner == 1
+        with pytest.raises(ValueError, match="scores"):
+            promote_fleet_winner(lc, models, [0.4])
+        with pytest.raises(ValueError, match="NaN"):
+            promote_fleet_winner(lc, models, [0.4, float("nan")])
+        with pytest.raises(ValueError, match="mode"):
+            promote_fleet_winner(lc, models, [0.4, 0.1], mode="median")
+
+    def test_fleet_model_arrays_kmeans(self, mesh8):
+        rng = np.random.RandomState(21)
+        X = np.concatenate(
+            [rng.randn(30, 3).astype(np.float32) + c for c in (-2.0, 2.0)]
+        )
+        (model,) = FitFleet([KMeans().set_k(2).set_seed(1).set_max_iter(4)]).fit(
+            Table({"features": X})
+        )
+        centroids, weights = fleet_model_arrays(model)
+        assert centroids.shape == (2, 3) and weights.shape == (2,)
+        assert centroids.dtype == np.float32

@@ -2390,3 +2390,136 @@ class TestUnledgeredResidency:
         }, ["unledgered-residency"])
         assert report.findings == []
         assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# vmap transparency: the fleet kernels wrap resident bodies in jax.vmap
+# (fleet.py / ops/optimizer.py `_sgd_fleet_*`) — resident-program and
+# spec-consistency must see THROUGH the batching wrapper: vmap changes
+# the batch axis, not residency or reduction structure
+# ---------------------------------------------------------------------------
+
+class TestResidentProgramVmap:
+    def test_true_positive_callback_in_vmapped_kernel(self, tmp_path):
+        """`NAME = lazy_jit(jax.vmap(impl))` — the fleet-kernel binding
+        idiom — is still ONE resident program; an in-body callback
+        re-enters the host every epoch for every member."""
+        report = _run(tmp_path, {
+            "ops/fleetbad.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+                from ..utils.lazyjit import lazy_jit
+
+                def _member_fit_impl(X, carry):
+                    def step(state):
+                        c, e = state
+                        jax.debug.print("member epoch {e}", e=e)
+                        return c + jnp.sum(X), e + 1
+                    return lax.while_loop(lambda s: s[1] < 10, step, carry)
+
+                _fleet_fit = lazy_jit(jax.vmap(_member_fit_impl))
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert len(report.findings) == 1
+        assert "jax.debug.print" in report.findings[0].message
+
+    def test_true_positive_callback_in_vmapped_loop_body(self, tmp_path):
+        """A loop body handed to lax.while_loop THROUGH a vmap wrapper is
+        resident for every fleet member."""
+        report = _run(tmp_path, {
+            "ops/fleetbad2.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+                from jax.experimental import io_callback
+
+                def fleet_fit(X):
+                    def cond(s):
+                        return s < 5
+                    def body(s):
+                        io_callback(print, None, s)
+                        return s + 1
+                    return lax.while_loop(
+                        jax.vmap(cond), jax.vmap(body), jnp.zeros(4))
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert len(report.findings) == 1
+        assert "io_callback" in report.findings[0].message
+
+    def test_true_negative_clean_vmapped_kernel(self, tmp_path):
+        """A callback-free vmapped kernel with host-side logging OUTSIDE
+        the program is the idiomatic fleet pattern — no finding."""
+        report = _run(tmp_path, {
+            "ops/fleetgood.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax import lax
+                from ..utils.lazyjit import lazy_jit
+
+                def _member_fit_impl(X, carry):
+                    def step(state):
+                        c, e = state
+                        return c + jnp.sum(X), e + 1
+                    return lax.while_loop(lambda s: s[1] < 10, step, carry)
+
+                _fleet_fit = lazy_jit(jax.vmap(_member_fit_impl))
+
+                def drive(X, carry):
+                    out = _fleet_fit(X, carry)
+                    print("fleet fit done")  # host side: fine
+                    return out
+            """,
+            **LAZYJIT_STUB,
+            "ops/__init__.py": "",
+        }, ["resident-program"])
+        assert report.findings == []
+
+
+class TestSpecConsistencyVmap:
+    def test_true_positive_unreduced_output_behind_vmap(self, tmp_path):
+        """A vmapped shard_map body that never reduces still publishes a
+        per-shard partial as the claimed-replicated result."""
+        report = _run(tmp_path, {
+            "models/fleetbad.py": """
+                import jax
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def member(x):
+                        return x * 2.0
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=jax.vmap(member))
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data[0] == "unreduced-output"
+
+    def test_true_negative_reduced_vmapped_body(self, tmp_path):
+        report = _run(tmp_path, {
+            "models/fleetgood.py": """
+                import jax
+                from jax.sharding import PartitionSpec as P
+                from ..parallel import collectives
+                from ..parallel.mesh import DATA_AXIS
+
+                def build(mesh):
+                    def member(x):
+                        return collectives.all_reduce_sum(x, DATA_AXIS)
+                    return collectives.shard_map_over(
+                        mesh, (P(DATA_AXIS),), P(), fn=jax.vmap(member))
+            """,
+            **SPMD_STUB,
+            **LAZYJIT_STUB,
+            "models/__init__.py": "",
+        }, ["spec-consistency"])
+        assert report.findings == []
