@@ -1698,6 +1698,372 @@ def bench_hot_swap_soak(num_batches=96, batch_rows=512, d=32, num_swaps=24):
     return result
 
 
+def bench_serving_slo(
+    d=24,
+    rows_per_req=4,
+    sweep=(250, 1000, 20000),
+    phase_s=0.5,
+    low_qps=40,
+    low_n=30,
+    deadline_ms=100.0,
+    n_tenants=6,
+    tenant_requests=240,
+    tenant_d=512,
+    in_budget=lambda: True,
+):
+    """The open-loop serving-SLO workload (ISSUE 19 / ROADMAP item 3),
+    asserted in-process:
+
+    1. **Bit-identity across batching modes** — the same request set
+       served per-request, fixed-batch, and continuously-batched must
+       produce bit-identical outputs per request (coalescing + padding
+       only ever adds copies of real rows to row-wise kernels).
+    2. **Continuous beats fixed where it should** — at low offered QPS
+       continuous batching's p99 (flush on the forming budget) must beat
+       fixed batching's (wait for a full bucket), and its goodput under a
+       deadline must too; at saturation its goodput must be at least
+       fixed's (both form full buckets there).
+    3. **Open-loop saturation sweep** — arrivals follow a fixed schedule
+       independent of completions (queueing delay stays honest, per the
+       Spark perf-study methodology): offered QPS sweeps to saturation,
+       reporting goodput (ok-within-deadline results/s), the saturation
+       knee, per-stage p50/p99/p999, and the deadline-miss split.
+    4. **Multi-tenant HBM paging, zero recompiles** — `n_tenants` models
+       whose combined constants exceed `config.model_store_bytes` serve
+       round-robin from ONE server through a `ModelStore`: the jit
+       compile counter must stay flat across steady-state paging (model
+       tensors are runtime operands), `hbm.live.model` must never exceed
+       the budget, and the store's ledger parity must hold at the end.
+    """
+    import jax
+
+    from flink_ml_tpu import config, flow
+    from flink_ml_tpu.data.modelstore import ModelStore
+    from flink_ml_tpu.models.classification.onlinelogisticregression import (
+        OnlineLogisticRegressionModel,
+    )
+    from flink_ml_tpu.models.feature.normalizer import Normalizer
+    from flink_ml_tpu.models.feature.standardscaler import StandardScalerModel
+    from flink_ml_tpu.obs import memledger, tracing
+    from flink_ml_tpu.pipeline import PipelineModel
+    from flink_ml_tpu.serving import MicroBatchServer, ServerOverloaded
+    from flink_ml_tpu.table import Table
+    from flink_ml_tpu.utils import metrics
+
+    rng = np.random.default_rng(19)
+    t_start = time.perf_counter()
+    tracing.install_jax_hooks()
+
+    def scaler_pipeline():
+        scaler = StandardScalerModel()
+        scaler.mean = rng.standard_normal(d)
+        scaler.std = np.abs(rng.standard_normal(d)) + 0.1
+        scaler.set_input_col("features").set_output_col("scaled")
+        norm = Normalizer().set_p(2.0).set_input_col("scaled").set_output_col("norm")
+        return PipelineModel([scaler, norm])
+
+    pm = scaler_pipeline()
+    feature = lambda rows: Table(
+        {"features": rng.standard_normal((rows, d), dtype=np.float32)}
+    )
+
+    # warm every bucket shape the phases touch (compiles are a fixed cost
+    # paid once per (plan, bucket); the SLO phases measure steady state)
+    for rows in (8, 32):
+        list(MicroBatchServer(pm, buckets=(8, 32)).serve(iter([feature(rows)])))
+
+    # -- 1. bit-identity: request vs fixed vs continuous -------------------
+    requests = [feature(int(r)) for r in rng.integers(1, 9, size=24)]
+
+    def serve_all(server, batches):
+        outputs = {}
+
+        def collect():
+            for r in server.results():
+                outputs[r.seq] = r
+
+        worker = flow.spawn(collect, name="slo.collect")
+        seqs = [server.submit(b) for b in batches]
+        server.close()
+        worker.join(timeout=120.0)
+        assert not worker.is_alive(), "collector wedged"
+        return [outputs[s] for s in seqs]
+
+    modes = {
+        "request": MicroBatchServer(pm, buckets=(8, 32), batching="request", admission=64),
+        "fixed": MicroBatchServer(
+            pm, buckets=(8, 32), batching="fixed", form_rows=8, admission=64
+        ),
+        "continuous": MicroBatchServer(
+            pm, buckets=(8, 32), batching="continuous", form_rows=32, admission=64
+        ),
+    }
+    per_mode = {name: serve_all(s, requests) for name, s in modes.items()}
+    for name in ("fixed", "continuous"):
+        for ref, got, batch in zip(per_mode["request"], per_mode[name], requests):
+            assert ref.status == got.status == "ok"
+            assert got.table.num_rows == batch.num_rows
+            assert np.array_equal(
+                np.asarray(ref.table.column("norm")), np.asarray(got.table.column("norm"))
+            ), f"{name} batching changed results vs the per-request path"
+
+    # -- open-loop load phases ---------------------------------------------
+    def run_phase(server, qps, duration_s, rows, tenant_fn=None, phase_deadline_ms=None):
+        """Open-loop: arrivals at t0 + i/qps regardless of completions.
+        Returns offered/goodput rates and client-observed latencies."""
+        recv: dict = {}
+        latencies: dict = {}
+        sent: dict = {}
+
+        def collect():
+            for r in server.results():
+                recv[r.seq] = r.status
+                if r.seq in sent:
+                    latencies[r.seq] = (time.monotonic() - sent[r.seq]) * 1000.0
+
+        worker = flow.spawn(collect, name="slo.collect")
+        payload = [feature(rows) for _ in range(8)]  # reuse: submit stays cheap
+        interval = 1.0 / qps
+        t0 = time.monotonic()
+        i = offered = rejects = 0
+        while True:
+            target = t0 + i * interval
+            if target > t0 + duration_s:
+                break
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                now = time.monotonic()
+                seq = server.submit(
+                    payload[i % len(payload)],
+                    deadline_ms=phase_deadline_ms,
+                    tenant=None if tenant_fn is None else tenant_fn(i),
+                )
+                sent[seq] = now
+                offered += 1
+            except ServerOverloaded:
+                rejects += 1
+            i += 1
+        server.close()
+        worker.join(timeout=300.0)
+        assert not worker.is_alive(), "collector wedged"
+        elapsed = time.monotonic() - t0
+        ok = sum(1 for s in recv.values() if s == "ok")
+        late = sum(1 for s in recv.values() if s == "late")
+        expired = sum(1 for s in recv.values() if s == "expired")
+        lat = sorted(latencies.values())
+        p = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
+        return {
+            "offeredQps": i / elapsed,
+            "goodputQps": ok / elapsed,
+            "ok": ok,
+            "late": late,
+            "expired": expired,
+            "rejected": rejects,
+            "p50Ms": p(0.50),
+            "p99Ms": p(0.99),
+        }
+
+    # -- 2. low offered QPS: the forming budget must bound latency ----------
+    low = {}
+    for name, kwargs in (
+        ("fixed", dict(batching="fixed", form_rows=8)),
+        ("continuous", dict(batching="continuous", form_rows=8)),
+    ):
+        server = MicroBatchServer(pm, buckets=(8,), admission=64, **kwargs)
+        low[name] = run_phase(
+            server, low_qps, low_n / low_qps, rows=1, phase_deadline_ms=deadline_ms
+        )
+    assert low["continuous"]["p99Ms"] < low["fixed"]["p99Ms"], (
+        f"continuous p99 {low['continuous']['p99Ms']:.1f}ms must beat fixed "
+        f"{low['fixed']['p99Ms']:.1f}ms at {low_qps} offered QPS"
+    )
+    assert low["continuous"]["goodputQps"] > low["fixed"]["goodputQps"], (
+        "a full-bucket wait past the deadline must cost fixed batching goodput"
+    )
+
+    # -- 3. saturation sweep, both modes ------------------------------------
+    sweeps = {"fixed": [], "continuous": []}
+    health = None
+    for qps in sweep:
+        for name in ("fixed", "continuous"):
+            if not in_budget():
+                break
+            server = MicroBatchServer(
+                pm,
+                buckets=(8, 32),
+                batching=name,
+                form_rows=32,
+                admission=64,
+                in_flight=2,
+            )
+            r = run_phase(server, qps, phase_s, rows=rows_per_req, phase_deadline_ms=deadline_ms)
+            r["targetQps"] = qps
+            sweeps[name].append(r)
+            if name == "continuous":
+                health = server.health()  # per-stage SLO surface
+    cont_sweep, fixed_sweep = sweeps["continuous"], sweeps["fixed"]
+    if cont_sweep and fixed_sweep:
+        sat_cont = max(r["goodputQps"] for r in cont_sweep)
+        sat_fixed = max(r["goodputQps"] for r in fixed_sweep)
+        # 0.8 margin, not parity: both modes form full buckets at
+        # saturation so the true ratio is ~1.0, but the 0.5s sweep phases
+        # make the measured ratio noisy under scheduler jitter (observed
+        # spread on a busy host reaches ~0.9) — the assert guards the
+        # collapse mode (per-request flushing ~0.6x), not the noise floor
+        assert sat_cont >= 0.8 * sat_fixed, (
+            f"continuous saturated goodput {sat_cont:.0f}/s fell below fixed "
+            f"{sat_fixed:.0f}/s — coalescing must not cost capacity"
+        )
+    else:  # sweep cut short by the budget: report the low-QPS phase rates
+        sat_cont = low["continuous"]["goodputQps"]
+        sat_fixed = low["fixed"]["goodputQps"]
+    # the knee: the highest offered rate the server still served ~fully
+    knee = 0.0
+    for r in cont_sweep:
+        if r["goodputQps"] >= 0.85 * r["offeredQps"]:
+            knee = max(knee, r["offeredQps"])
+
+    # -- 4. multi-tenant paging: N models, budget for ~3, zero recompiles ---
+    tenants = [f"tenant{i}" for i in range(n_tenants)]
+    probe_store = ModelStore(budget_bytes=None)
+
+    def tenant_model(seed):
+        trng = np.random.default_rng(seed)
+        scaler = StandardScalerModel()
+        scaler.mean = trng.standard_normal(tenant_d)
+        scaler.std = np.abs(trng.standard_normal(tenant_d)) + 0.1
+        scaler.set_input_col("features").set_output_col("features")
+        olr = OnlineLogisticRegressionModel()
+        olr.publish_model_arrays((trng.standard_normal(tenant_d),), 0)
+        olr.set_features_col("features").set_prediction_col("pred")
+        return PipelineModel([scaler, olr])
+
+    tenant_models = {t: tenant_model(100 + i) for i, t in enumerate(tenants)}
+    probe_store.register(tenants[0], tenant_models[tenants[0]])
+    per_model = probe_store.estimated_nbytes(tenants[0])
+    budget = int(per_model * 3.3)  # room for 3 of n_tenants residents
+    assert n_tenants * per_model > budget, "the paging phase must exceed the budget"
+    store = ModelStore(budget_bytes=budget)
+    for t in tenants:
+        store.register(t, tenant_models[t], quota=16)
+    server = MicroBatchServer(
+        store=store,
+        buckets=(8, 32),
+        batching="continuous",
+        form_rows=32,
+        admission=64,
+    )
+    tfeature = lambda rows: Table(
+        {"features": rng.standard_normal((rows, tenant_d), dtype=np.float32)}
+    )
+
+    def serve_tenants(count, start=0):
+        """Round-robin tenant requests; every submit samples the model
+        ledger so the budget claim covers the whole phase, not endpoints."""
+        outputs = {}
+        peak = 0
+
+        def collect():
+            for r in server.results():
+                outputs[r.seq] = r
+
+        worker = flow.spawn(collect, name="slo.tenants")
+        for i in range(count):
+            while True:  # closed-loop pacing: this phase measures paging
+                try:
+                    server.submit(
+                        tfeature(rows_per_req), tenant=tenants[(start + i) % n_tenants]
+                    )
+                    break
+                except ServerOverloaded:
+                    time.sleep(0.002)
+            peak = max(peak, memledger.live_bytes("model"))
+        server.close()
+        worker.join(timeout=300.0)
+        assert not worker.is_alive(), "tenant collector wedged"
+        peak = max(peak, memledger.live_bytes("model"))
+        return outputs, peak
+
+    # warmup: every tenant's fused plan compiles ONCE per bucket shape
+    # (first touch, through the paging store); the steady phase below then
+    # pages with the compile counter pinned
+    for t in tenants:
+        list(
+            MicroBatchServer(store.acquire(t), buckets=(8, 32)).serve(
+                iter([tfeature(8), tfeature(32)])
+            )
+        )
+    outputs, _ = serve_tenants(n_tenants * 2)
+    assert all(r.status == "ok" for r in outputs.values())
+    server = MicroBatchServer(
+        store=store, buckets=(8, 32), batching="continuous", form_rows=32, admission=64
+    )
+    compiles_before = metrics.get_counter("jit.compiles", 0)
+    page_ins_before = metrics.get_counter("modelstore.pageIn", 0)
+    t_paged = time.perf_counter()
+    outputs, peak_model_bytes = serve_tenants(tenant_requests, start=1)
+    paged_s = time.perf_counter() - t_paged
+    recompiles = metrics.get_counter("jit.compiles", 0) - compiles_before
+    page_ins = metrics.get_counter("modelstore.pageIn", 0) - page_ins_before
+    assert recompiles == 0, f"{recompiles} recompiles during steady-state paging"
+    assert len(outputs) == tenant_requests and all(
+        r.status == "ok" for r in outputs.values()
+    ), "every tenant request must retire ok"
+    assert peak_model_bytes <= budget, (
+        f"hbm.live.model peaked at {peak_model_bytes} over the {budget} budget"
+    )
+    assert page_ins > 0, "the round-robin phase must actually page"
+    store.check_ledger_parity()
+    jax.block_until_ready([])
+
+    offered_top = max((r["offeredQps"] for r in cont_sweep), default=float(low_qps))
+    metrics.set_gauge("serving.offeredQps", offered_top)
+    metrics.set_gauge("serving.goodputQps", sat_cont)
+    metrics.set_gauge("serving.saturationQps", knee)
+
+    result = {
+        "offeredQps": offered_top,
+        "goodputQps": sat_cont,
+        "saturationQps": knee,
+        "fixedGoodputQps": sat_fixed,
+        "lowQps": {
+            "offered": low_qps,
+            "continuousP99Ms": low["continuous"]["p99Ms"],
+            "fixedP99Ms": low["fixed"]["p99Ms"],
+            "continuousGoodputQps": low["continuous"]["goodputQps"],
+            "fixedGoodputQps": low["fixed"]["goodputQps"],
+        },
+        "sweep": {name: rs for name, rs in sweeps.items()},
+        "deadlineMissLate": sum(r["late"] for r in cont_sweep),
+        "deadlineMissExpired": sum(r["expired"] for r in cont_sweep),
+        "rejected": sum(r["rejected"] for r in cont_sweep),
+        "stageLatencyMs": health.stageLatencyMs if health else None,
+        # the multi-tenant paging phase
+        "tenants": n_tenants,
+        "modelStoreBudgetBytes": budget,
+        "perModelBytes": int(per_model),
+        "pageInCount": int(page_ins),
+        "pageInQps": page_ins / paged_s if paged_s else 0.0,
+        "peakModelBytes": int(peak_model_bytes),
+        "modelStore": store.stats,
+        "recompileCount": int(recompiles),  # asserted 0
+        "bitIdentical": True,  # asserted above
+        "peakHbmBytes": int(memledger.peak_bytes()),
+        "wallMs": (time.perf_counter() - t_start) * 1000.0,
+    }
+    log(
+        f"servingSlo: knee {knee:.0f} req/s of {offered_top:.0f} offered, goodput "
+        f"{sat_cont:.0f}/s continuous vs {sat_fixed:.0f}/s fixed; low-QPS p99 "
+        f"{low['continuous']['p99Ms']:.1f}ms vs {low['fixed']['p99Ms']:.1f}ms; "
+        f"{n_tenants} tenants in a {budget / 1e3:.0f}KB budget paged {page_ins}x "
+        f"({result['pageInQps']:.0f}/s) with 0 recompiles, peak model bytes "
+        f"{peak_model_bytes}"
+    )
+    return result
+
+
 def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
     """The comm-layer workload (ISSUE 4): per-device-count collective
     traffic and wall time from scripts/bench_collectives.py — bucketed
@@ -1776,6 +2142,7 @@ def main(argv):
         "elasticRecovery": None,
         "overloadSoak": None,
         "hotSwapSoak": None,
+        "servingSlo": None,
         "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
@@ -1905,6 +2272,12 @@ def main(argv):
                 details["hotSwapSoak"] = bench_hot_swap_soak()
             except Exception as e:
                 log(f"hotSwapSoak stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["servingSlo"] = bench_serving_slo(in_budget=in_budget)
+            except Exception as e:
+                log(f"servingSlo stage failed: {e!r}")
 
         if in_budget():
             try:

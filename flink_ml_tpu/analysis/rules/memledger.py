@@ -10,7 +10,9 @@ of an accounted funnel, the HBM ledger (obs/memledger.py) never sees it —
 under-report by exactly that allocation. Function-local device arrays are
 out of scope (transients the GC reclaims with the frame); so is anything
 staged through `stage_to_device`/`stage_from_callback` (tracked when a
-category is declared) or explicitly `memledger.track`-ed.
+category is declared), reached via `device_constants()`/the model store's
+`page_in` (both stage every byte through the accounted path), or
+explicitly `memledger.track`-ed.
 """
 
 from __future__ import annotations
@@ -46,7 +48,16 @@ NUMPY_CREATORS = frozenset(
 #: and the explicit tracking API) — their presence anywhere in the RHS
 #: exempts the assignment.
 FUNNEL_CALLS = frozenset(
-    {"stage_to_device", "stage_from_callback", "track", "device_constants"}
+    {
+        "stage_to_device",
+        "stage_from_callback",
+        "track",
+        "device_constants",
+        # the ModelStore paging path: page_in stages every resident model
+        # byte through device_constants() -> stage_to_device(category=
+        # "model"), so a binding fed by it is ledgered by construction
+        "page_in",
+    }
 )
 
 _JAX_MODULES = {"jax"}

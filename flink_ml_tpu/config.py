@@ -363,6 +363,53 @@ serving_admission: int = 16
 # deadline): a request whose deadline passes before dispatch is shed
 # (`serving.deadlineMiss`), one that finishes late is delivered marked late.
 serving_deadline_ms: Optional[float] = None
+# Continuous-batching forming budget (serving.MicroBatchServer with
+# batching="continuous"): the longest a request may wait in the FORMING
+# bucket before the partial batch dispatches anyway. A forming batch goes
+# out when it fills its target bucket OR when its oldest request's
+# deadline margin (deadline - now; submit time + budget when the request
+# has no deadline) hits this budget — so latency at low offered QPS is
+# bounded by the budget while throughput at high QPS gets full buckets.
+serving_form_budget_ms: float = 5.0
+# HBM byte budget for the multi-tenant device-resident model store
+# (data/modelstore.py): registered models page host<->HBM under an LRU
+# policy so far more models than fit in device memory serve from one
+# mesh. Ledgered under the memledger `model` category — the store keeps
+# `hbm.live.model` at or below this budget. None = unbounded (no paging
+# pressure; everything stays resident after first touch).
+model_store_bytes: Optional[int] = None
+
+
+@contextmanager
+def serving_form_budget(budget_ms: float):
+    """Scoped override of `serving_form_budget_ms`."""
+    global serving_form_budget_ms
+    prev = serving_form_budget_ms
+    serving_form_budget_ms = max(0.0, float(budget_ms))
+    try:
+        yield
+    finally:
+        serving_form_budget_ms = prev
+
+
+@contextmanager
+def model_store_budget(budget_bytes: Optional[int]):
+    """Scoped override of `model_store_bytes` (None = unbounded)."""
+    global model_store_bytes
+    prev = model_store_bytes
+    model_store_bytes = None if budget_bytes is None else max(0, int(budget_bytes))
+    try:
+        yield
+    finally:
+        model_store_bytes = prev
+
+
+if os.environ.get("FLINK_ML_TPU_SERVING_FORM_BUDGET_MS"):
+    serving_form_budget_ms = max(
+        0.0, float(os.environ["FLINK_ML_TPU_SERVING_FORM_BUDGET_MS"])
+    )
+if os.environ.get("FLINK_ML_TPU_MODEL_STORE_BYTES"):
+    model_store_bytes = max(0, int(os.environ["FLINK_ML_TPU_MODEL_STORE_BYTES"]))
 
 
 @contextmanager

@@ -121,6 +121,12 @@ def snapshot_prometheus(
         lines.append(f"# TYPE {base}_count counter")
         lines.append(f"{base}_count {stats['count']}")
     for name, h in sorted(hists.items()):
+        if not h.get("count", 0):
+            # zero observations: emitting an all-zero bucket series would
+            # invite scrapers to interpolate percentiles out of nothing —
+            # the histogram appears once it has a sample (matching
+            # ServerHealth.stageLatencyMs reporting None for empty stages)
+            continue
         base = _prom_name(prefix, name)
         lines.append(f"# TYPE {base} histogram")
         cum = 0
@@ -164,6 +170,13 @@ BENCH_FIELDS = (
     "swapCount",
     "rollbackCount",
     "promoteRejected",
+    # the serving-SLO surface (PR 19): open-loop load-gen rates, model
+    # store paging, and the zero-tolerance recompile pin
+    "offeredQps",
+    "goodputQps",
+    "saturationQps",
+    "pageInCount",
+    "recompileCount",
 )
 
 

@@ -140,14 +140,20 @@ class Histogram:
     def percentile(self, q: float) -> Optional[float]:
         """The q-quantile (q in [0, 1]) by cumulative bucket walk with
         linear interpolation inside the landing bucket, clamped to the
-        observed [min, max]. None on an empty histogram."""
+        observed [min, max]. None on an empty histogram — including a
+        nonzero `count` with an all-zero bucket array (a summary rebuilt
+        via `from_dict(include_buckets=False)` output): interpolating a
+        percentile out of buckets that hold no observations would report
+        fiction, so those answer None too."""
         if self.count == 0:
             return None
         target = q * self.count
         cum = 0.0
+        seen = False
         for i, c in enumerate(self.counts):
             if c == 0:
                 continue
+            seen = True
             if cum + c >= target:
                 lo = 0.0 if i == 0 else bucket_upper_bound(i - 1)
                 hi = bucket_upper_bound(i)
@@ -155,7 +161,7 @@ class Histogram:
                 v = lo + frac * (hi - lo)
                 return min(max(v, self.vmin), self.vmax)
             cum += c
-        return self.vmax
+        return self.vmax if seen and self.vmax != -math.inf else None
 
     def to_dict(self, include_buckets: bool = True) -> Dict:
         """Snapshot: summary stats + percentiles (+ the sparse nonzero

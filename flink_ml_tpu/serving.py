@@ -50,7 +50,29 @@ flakes. Mechanisms on top of the fusion planner:
    errors rolls traffic back to the last-good version automatically
    (docs/model_lifecycle.md).
 
-Results are yielded IN ORDER. A batch's guard failure (e.g. Bucketizer
+6. **Continuous batching + the multi-tenant model store** — with
+   `batching="continuous"` the dispatch worker admits requests into the
+   FORMING batch mid-flight instead of dispatching each submit alone: a
+   forming batch goes out the moment it fills its target bucket
+   (`form_rows`) OR its oldest request's deadline margin hits the
+   forming budget (`config.serving_form_budget_ms`), so throughput at
+   saturation gets full buckets while latency at low offered QPS stays
+   bounded by the budget. `batching="fixed"` is the classic baseline
+   (wait for a full batch, however long that takes) the `servingSlo`
+   bench compares against; results are bit-identical across all three
+   modes because the kernels are row-wise and the pad rows are copies of
+   real rows. Requests carry an optional `tenant`: a forming batch never
+   coalesces across tenants, each tenant may route to its own model via
+   a `data.modelstore.ModelStore` (HBM-paged under an LRU byte budget —
+   far more models than fit on device serve from one mesh, zero
+   recompiles on page-in because model tensors are runtime operands),
+   and per-tenant reject-policy quota gates keep one tenant's overload
+   from starving another (docs/serving.md).
+
+Pull-loop (`serve`) results are yielded IN ORDER. Push-loop results
+retire in dispatch order, which is submission order WITHIN a tenant
+(forming batches flush FIFO per tenant); across tenants, coalescing may
+legitimately reorder. A batch's guard failure (e.g. Bucketizer
 handleInvalid='error') raises when that batch is yielded — at most
 `in_flight` batches later than the eager path would have raised, never
 reordered and never dropped. When the consumer abandons `serve` early (a
@@ -61,6 +83,7 @@ or queue slots leak (`serving.cancelled` counts the released batches).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
@@ -88,16 +111,20 @@ __all__ = [
 # same policy, same guard-safety argument, one implementation.
 _next_bucket, _pad_rows, _slice_rows = next_bucket, pad_rows, slice_rows
 
+BATCHING_MODES = ("request", "fixed", "continuous")
+
 
 class ServerOverloaded(flow.ChannelRejected):
-    """`submit` fast-fail: the admission queue is full. Carries the live
-    queue depth and capacity (inherited from `flow.ChannelRejected`) so a
-    client can back off / divert instead of parsing a message."""
+    """`submit` fast-fail: the admission queue (or the submitting
+    tenant's quota gate — `channel` = `serving.tenant.<name>`) is full.
+    Carries the live queue depth and capacity (inherited from
+    `flow.ChannelRejected`) so a client can back off / divert instead of
+    parsing a message."""
 
 
 @dataclass
 class ServeResult:
-    """One retired request from the push API, in submission order.
+    """One retired request from the push API, FIFO per tenant.
     `status` is `"ok"`, `"late"` (finished past its deadline), `"expired"`
     (deadline passed before dispatch — no compute paid, `table` is None)
     or `"error"` (`error` holds the exception; the stream continues)."""
@@ -106,6 +133,7 @@ class ServeResult:
     status: str
     table: Optional[Table] = None
     error: Optional[BaseException] = None
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -136,19 +164,29 @@ class ServerHealth:
     hbmLiveBytes: int = 0
     hbmPeakBytes: int = 0
     # per-stage latency percentiles from obs/hist.py (p50/p90/p99/p999 +
-    # count per stage: queueWait, batchForm, dispatch, readback,
-    # deadlineMargin) — the SLO surface; empty until samples exist or
-    # when histograms are disabled
-    stageLatencyMs: Dict[str, Dict[str, float]] = None
+    # count per stage). EVERY stage label is present; a stage with zero
+    # observations maps to None — never percentiles interpolated from an
+    # empty bucket array (the Prometheus exporter likewise skips empty
+    # histograms entirely)
+    stageLatencyMs: Dict[str, Optional[Dict[str, float]]] = None
+    # per-tenant quota-gate view: {tenant: {admitted, rejected, depth,
+    # capacity}} for every tenant that has a quota gate (empty when no
+    # tenant quotas are configured) — the fairness soak reads this
+    tenantAdmission: Dict[str, Dict[str, int]] = None
+    # attached ModelStore stats (models/resident/bytes/hits/misses/
+    # evictions) or None when the server serves a single model
+    modelStore: Optional[Dict[str, int]] = None
 
     #: The serving stage-attribution histograms (obs/hist.py names, all
-    #: in milliseconds): queue-wait (submit -> dispatch start), batch
-    #: formation (pad + H2D upload), dispatch (fused-plan launch),
-    #: readback (the one blocking guard drain), and the remaining
-    #: deadline margin at delivery (clamped at 0; lateness lands in
-    #: `serving.lateByMs` and the deadlineMiss.late counter).
+    #: in milliseconds): queue-wait (submit -> dequeue), forming wait
+    #: (dequeue -> the coalesced batch's flush; continuous/fixed modes
+    #: only), batch formation (pad + H2D upload), dispatch (fused-plan
+    #: launch), readback (the one blocking guard drain), and the
+    #: remaining deadline margin at delivery (clamped at 0; lateness
+    #: lands in `serving.lateByMs` and the deadlineMiss.late counter).
     STAGES = (
         ("queueWait", "serving.queueWaitMs"),
+        ("formWait", "serving.formWaitMs"),
         ("batchForm", "serving.batchFormMs"),
         ("dispatch", "serving.dispatchMs"),
         ("readback", "serving.readbackMs"),
@@ -156,8 +194,28 @@ class ServerHealth:
     )
 
 
+class _Forming:
+    """One tenant's forming batch: requests coalescing toward a bucket.
+    `flush_at` is the earliest member's forming deadline — `inf` under
+    fixed batching (only a full bucket or server close flushes)."""
+
+    __slots__ = ("tenant", "sig", "reqs", "rows", "flush_at")
+
+    def __init__(self, tenant, sig):
+        self.tenant = tenant
+        self.sig = sig
+        self.reqs: List[Tuple[int, Table, Optional[float], float]] = []
+        self.rows = 0
+        self.flush_at = float("inf")
+
+    def add(self, seq: int, batch: Table, deadline: Optional[float], flush_at: float) -> None:
+        self.reqs.append((seq, batch, deadline, time.monotonic()))
+        self.rows += batch.num_rows
+        self.flush_at = min(self.flush_at, flush_at)
+
+
 class MicroBatchServer:
-    """Drives a PipelineModel's fused transform plan over a batch stream.
+    """Drives fused transform plans over a batch stream.
 
     `in_flight` bounds the transformed-but-undrained window (default
     `config.serving_in_flight`); `buckets` optionally pins the padded
@@ -170,6 +228,21 @@ class MicroBatchServer:
     deadline (None = none); `retries` the transient-fault retry budget for
     batch dispatch (default `config.transient_retries`).
 
+    Batching policy (`batching`): `"request"` (default) dispatches every
+    submitted batch alone; `"continuous"` coalesces per-tenant forming
+    batches that flush on bucket-full OR forming-budget expiry
+    (`form_budget_ms`, default `config.serving_form_budget_ms`);
+    `"fixed"` flushes only on bucket-full (the classic fixed-batch
+    baseline). `form_rows` is the target bucket (default: the largest
+    configured bucket, else 64).
+
+    Multi-tenancy: pass a `data.modelstore.ModelStore` as `store` and
+    submit with `tenant=<key>` — each request dispatches against its
+    tenant's (HBM-paged) model. Per-tenant admission quotas come from
+    the store's registrations or the `tenant_quotas` mapping; a tenant
+    past its quota gets `ServerOverloaded` without consuming shared
+    admission capacity.
+
     Two consumption styles:
 
     - `serve(stream)` — the pull loop: the caller owns pacing, the window
@@ -181,7 +254,7 @@ class MicroBatchServer:
 
     def __init__(
         self,
-        model: PipelineModel,
+        model: Optional[PipelineModel] = None,
         in_flight: Optional[int] = None,
         buckets: Optional[Sequence[int]] = None,
         device_input: bool = True,
@@ -189,12 +262,34 @@ class MicroBatchServer:
         deadline_ms: Optional[float] = None,
         retries: Optional[int] = None,
         lifecycle=None,
+        batching: str = "request",
+        form_rows: Optional[int] = None,
+        form_budget_ms: Optional[float] = None,
+        store=None,
+        tenant_quotas: Optional[Dict[str, int]] = None,
     ):
-        if not isinstance(model, PipelineModel):
+        if model is None and store is None:
+            raise TypeError("MicroBatchServer needs a model, a ModelStore, or both")
+        if model is not None and not isinstance(model, PipelineModel):
             raise TypeError(f"MicroBatchServer serves a PipelineModel, got {type(model).__name__}")
+        if batching not in BATCHING_MODES:
+            raise ValueError(f"unknown batching mode {batching!r} (one of {BATCHING_MODES})")
         self.model = model
+        self.store = store
+        self.batching = batching
         self.in_flight = max(1, int(in_flight if in_flight is not None else config.serving_in_flight))
         self.buckets = sorted(int(b) for b in buckets) if buckets else None
+        self.form_rows = max(
+            1,
+            int(
+                form_rows
+                if form_rows is not None
+                else (self.buckets[-1] if self.buckets else 64)
+            ),
+        )
+        self.form_budget_ms = (
+            form_budget_ms if form_budget_ms is not None else config.serving_form_budget_ms
+        )
         self.device_input = device_input
         self.admission = max(
             1, int(admission if admission is not None else config.serving_admission)
@@ -208,6 +303,8 @@ class MicroBatchServer:
         # a pointer exchange the next batch picks up
         self.lifecycle = lifecycle
         self.watchdog = flow.StragglerWatchdog("serving.batch")
+        self._tenant_quotas = dict(tenant_quotas) if tenant_quotas else {}
+        self._tenant_gates: Dict[str, flow.BoundedChannel] = {}
         self._buckets_seen: set = set()
         self._counts: Dict[str, int] = {
             "completed": 0,
@@ -221,6 +318,7 @@ class MicroBatchServer:
         self._requests: Optional[flow.BoundedChannel] = None
         self._out: Optional[flow.BoundedChannel] = None
         self._worker = None
+        self._start_lock = threading.Lock()
         self._seq = 0
 
     # -- batch staging -------------------------------------------------------
@@ -262,19 +360,33 @@ class MicroBatchServer:
             and col.dtype.kind not in ("U", "S")
         )
 
-    def _dispatch(self, batch: Table, index: int):
+    def _model_for(self, tenant: Optional[str]) -> PipelineModel:
+        """Resolve a request's model: the tenant's store entry (paged in
+        on the spot — an LRU hit is a dict touch, a miss stages through
+        the accounted funnel) or the server-wide default."""
+        if self.store is not None and tenant is not None:
+            return self.store.acquire(tenant)
+        if self.model is None:
+            raise TypeError(
+                "MicroBatchServer has no default model: submit with tenant= "
+                "or construct with model="
+            )
+        return self.model
+
+    def _dispatch(self, batch: Table, index: int, model: Optional[PipelineModel] = None):
         """Stage + dispatch one batch under the transient-retry budget
         and the straggler watchdog. The `serving.batch` fault site sits
         inside the retried unit, so a `faults.flaky` plan exercises the
         retry path end to end; staging re-runs with the dispatch (an
         upload that failed mid-flight cannot be trusted half-done)."""
+        served = model if model is not None else self._model_for(None)
 
         def attempt():
             faults.tick("serving.batch")
             t0 = time.perf_counter()
             staged, n = self._stage_batch(batch)
             t1 = time.perf_counter()
-            out, pending = self.model.transform_deferred(staged)
+            out, pending = served.transform_deferred(staged)
             t2 = time.perf_counter()
             # stage attribution (obs/hist.py): where a request's latency
             # sits BEFORE the blocking drain — the serving mirror of the
@@ -380,34 +492,95 @@ class MicroBatchServer:
     # -- the push serving loop: admission control + deadlines ----------------
     def start(self) -> None:
         """Bring up the dispatch worker and its channels (idempotent;
-        `submit` auto-starts)."""
+        `submit` auto-starts). Locked double-check: a `results()`
+        consumer thread and the first `submit()` race here, and two
+        winners would each spawn a dispatch worker over its own channel
+        pair — the loser's results would emit into an orphaned stream."""
         if self._worker is not None:
             return
-        self._requests = flow.BoundedChannel(
-            self.admission, policy=flow.REJECT, name="serving.admit"
-        )
-        # results buffer: sized so a retired batch never blocks the worker
-        # while the admission queue and window both stay full — the
-        # consumer's pull pace backpressures through it
-        self._out = flow.BoundedChannel(
-            self.admission + self.in_flight + 1, policy=flow.BLOCK, name="serving.results"
-        )
-        metrics.set_gauge("serving.in_flight", self.in_flight)
-        self._worker = flow.spawn(self._run, name="serving.dispatch")
+        with self._start_lock:
+            if self._worker is not None:
+                return
+            self._requests = flow.BoundedChannel(
+                self.admission, policy=flow.REJECT, name="serving.admit"
+            )
+            # results buffer: sized so a retired batch never blocks the
+            # worker while the admission queue and window both stay full —
+            # the consumer's pull pace backpressures through it. Forming
+            # batches can coalesce many admitted requests into one window
+            # entry, so the retire fan-out is still bounded by `admission`
+            self._out = flow.BoundedChannel(
+                self.admission + self.in_flight + 1, policy=flow.BLOCK, name="serving.results"
+            )
+            metrics.set_gauge("serving.in_flight", self.in_flight)
+            # assigned last: `submit`/`results` treat a non-None worker as
+            # "channels are live", so this publish orders after them
+            self._worker = flow.spawn(self._run, name="serving.dispatch")
 
-    def submit(self, batch: Table, deadline_ms: Optional[float] = None) -> int:
+    # -- per-tenant quota gates ----------------------------------------------
+    def _quota_gate(self, tenant: Optional[str]) -> Optional[flow.BoundedChannel]:
+        """The tenant's reject-policy admission gate (created lazily from
+        `tenant_quotas` or the store's registration), or None for
+        unquota'd tenants. Each admitted request holds one credit until
+        it leaves the queue+forming pipeline (dispatch/expiry)."""
+        if tenant is None:
+            return None
+        gate = self._tenant_gates.get(tenant)
+        if gate is None:
+            quota = self._tenant_quotas.get(tenant)
+            if quota is None and self.store is not None and tenant in self.store:
+                quota = self.store.quota(tenant)
+            if quota is None:
+                return None
+            gate = flow.BoundedChannel(
+                max(1, int(quota)), policy=flow.REJECT, name=f"serving.tenant.{tenant}"
+            )
+            self._tenant_gates[tenant] = gate
+        return gate
+
+    def _quota_release(self, tenant: Optional[str]) -> None:
+        if tenant is None:
+            return
+        gate = self._tenant_gates.get(tenant)
+        if gate is None:
+            return
+        try:
+            gate.get(timeout=0)
+        except (TimeoutError, flow.ChannelClosed):
+            pass
+
+    def submit(
+        self,
+        batch: Table,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ) -> int:
         """Admit one batch, returning its sequence number. Raises
         `ServerOverloaded` (with the live queue depth) when `admission`
-        requests already wait — the typed fast-fail of the `reject`
-        policy. `deadline_ms` overrides the server default."""
+        requests already wait — or when `tenant`'s quota gate is full —
+        the typed fast-fail of the `reject` policy. `deadline_ms`
+        overrides the server default; `tenant` routes to that tenant's
+        store model and quota."""
         if self._worker is None:
             self.start()
+        if self.store is not None and tenant is not None and tenant not in self.store:
+            raise KeyError(f"tenant {tenant!r} is not registered in the model store")
         ms = deadline_ms if deadline_ms is not None else self.deadline_ms
         deadline = None if ms is None else time.monotonic() + ms / 1000.0
         seq = self._seq
+        gate = self._quota_gate(tenant)
+        if gate is not None:
+            try:
+                gate.put(seq)
+            except flow.ChannelRejected as e:
+                metrics.inc_counter("serving.rejected")
+                metrics.inc_counter(f"serving.rejected.tenant.{tenant}")
+                raise ServerOverloaded(e.channel, e.depth, e.capacity) from None
         try:
-            self._requests.put((seq, batch, deadline, time.monotonic()))
+            self._requests.put((seq, tenant, batch, deadline, time.monotonic()))
         except flow.ChannelRejected as e:
+            if gate is not None:  # refund the tenant credit
+                self._quota_release(tenant)
             metrics.inc_counter("serving.rejected")
             raise ServerOverloaded(e.channel, e.depth, e.capacity) from None
         self._seq += 1
@@ -416,13 +589,13 @@ class MicroBatchServer:
         return seq
 
     def close(self) -> None:
-        """No more submits; the worker drains what was admitted and closes
-        the results stream."""
+        """No more submits; the worker drains what was admitted (flushing
+        any partial forming batches) and closes the results stream."""
         if self._requests is not None:
             self._requests.close()
 
     def results(self) -> Iterator[ServeResult]:
-        """Retired requests in submission order (`ServeResult`); ends when
+        """Retired requests (`ServeResult`), FIFO per tenant; ends when
         `close()` has been called and every admitted request retired."""
         if self._worker is None:
             self.start()
@@ -432,18 +605,30 @@ class MicroBatchServer:
         """A `ServerHealth` snapshot of queues, overload decisions, retry
         spend, dispatch latency, and the per-stage latency percentiles
         (`stageLatencyMs`, from the obs/hist.py histograms)."""
-        stage_latency: Dict[str, Dict[str, float]] = {}
+        stage_latency: Dict[str, Optional[Dict[str, float]]] = {}
         for label, hist_name in ServerHealth.STAGES:
             p = hist.percentiles(hist_name)
-            if p is not None:
-                stage_latency[label] = {
-                    k: p[k] for k in ("count", "p50", "p90", "p99", "p999")
-                }
+            # a stage with zero observations reports None — percentiles
+            # interpolated from an empty bucket array would be fiction
+            stage_latency[label] = (
+                None
+                if p is None
+                else {k: p[k] for k in ("count", "p50", "p90", "p99", "p999")}
+            )
+        tenants: Dict[str, Dict[str, int]] = {}
+        for tenant, gate in self._tenant_gates.items():
+            tenants[tenant] = {
+                "admitted": gate.stats.puts,
+                "rejected": gate.stats.rejected,
+                "depth": len(gate),
+                "capacity": gate.capacity,
+            }
         window_depth = len(self._window) if self._window is not None else 0
         adm_depth = len(self._requests) if self._requests is not None else 0
         rejected = (
             self._requests.stats.rejected if self._requests is not None else 0
         )
+        rejected += sum(g.stats.rejected for g in self._tenant_gates.values())
         submitted = self._requests.stats.puts if self._requests is not None else 0
         return ServerHealth(
             inFlight=self.in_flight,
@@ -464,39 +649,22 @@ class MicroBatchServer:
             hbmLiveBytes=memledger.live_bytes(),
             hbmPeakBytes=memledger.peak_bytes(),
             stageLatencyMs=stage_latency,
+            tenantAdmission=tenants,
+            modelStore=self.store.stats if self.store is not None else None,
         )
 
     def _run(self) -> None:
-        """Dispatch worker: admission queue → window → results, deadlines
-        enforced at both ends. Any worker-level failure closes the results
-        channel with the error — consumers re-raise instead of hanging."""
+        """Dispatch worker: admission queue → (forming) → window →
+        results, deadlines enforced at every hop. Any worker-level
+        failure closes the results channel with the error — consumers
+        re-raise instead of hanging."""
         window = flow.BoundedChannel(self.in_flight, policy=flow.BLOCK, name="serving.window")
         self._window = window
         try:
-            for seq, batch, deadline, submitted in self._requests:
-                hist.record(
-                    "serving.queueWaitMs", (time.monotonic() - submitted) * 1000.0
-                )
-                if deadline is not None and time.monotonic() > deadline:
-                    # shed BEFORE paying staging/compute: the client
-                    # already gave up on this request. Cause-attributed:
-                    # expired-IN-QUEUE (vs late-after-dispatch below) —
-                    # `serving.deadlineMiss` stays the compatibility sum
-                    metrics.inc_counter("serving.deadlineMiss")
-                    metrics.inc_counter("serving.deadlineMiss.expired")
-                    self._count("expired")
-                    self._emit(ServeResult(seq, "expired"))
-                    continue
-                try:
-                    entry = self._dispatch(batch, seq)
-                except Exception as e:  # per-request failure: stream survives
-                    self._count("errors")
-                    self._emit(ServeResult(seq, "error", error=e))
-                    continue
-                if not window.offer((seq, deadline) + entry):
-                    # tpulint: disable=untimed-wait -- dispatch-worker-local window: offer() just returned False, so the window is non-empty and get() cannot block
-                    self._retire(window.get())
-                    window.offer((seq, deadline) + entry)
+            if self.batching == "request":
+                self._run_per_request(window)
+            else:
+                self._run_forming(window)
             while len(window):
                 # tpulint: disable=untimed-wait -- dispatch-worker-local window: guarded by len(window) > 0, get() cannot block
                 self._retire(window.get())
@@ -506,28 +674,278 @@ class MicroBatchServer:
         finally:
             self._release(window)
 
-    def _retire(self, entry) -> None:
-        seq, deadline, out, pending, n = entry
-        try:
-            table = self._finish(out, pending, n)
-        except Exception as e:  # deferred guard error: per-request, in order
-            self._count("errors")
-            self._emit(ServeResult(seq, "error", error=e))
-            return
-        status = "ok"
-        if deadline is not None:
-            margin_ms = (deadline - time.monotonic()) * 1000.0
-            if margin_ms < 0:
-                # cause-attributed miss: finished LATE after dispatch (the
-                # compute was paid — contrast deadlineMiss.expired)
+    def _run_per_request(self, window: flow.BoundedChannel) -> None:
+        """The classic loop: every submitted batch dispatches alone."""
+        for seq, tenant, batch, deadline, submitted in self._requests:
+            hist.record(
+                "serving.queueWaitMs", (time.monotonic() - submitted) * 1000.0
+            )
+            self._quota_release(tenant)
+            if deadline is not None and time.monotonic() > deadline:
+                # shed BEFORE paying staging/compute: the client
+                # already gave up on this request. Cause-attributed:
+                # expired-IN-QUEUE (vs late-after-dispatch below) —
+                # `serving.deadlineMiss` stays the compatibility sum
                 metrics.inc_counter("serving.deadlineMiss")
-                metrics.inc_counter("serving.deadlineMiss.late")
-                hist.record("serving.lateByMs", -margin_ms)
-                self._count("late")
-                status = "late"
+                metrics.inc_counter("serving.deadlineMiss.expired")
+                self._count("expired")
+                self._emit(ServeResult(seq, "expired", tenant=tenant))
+                continue
+            try:
+                model = self._model_for(tenant)
+                out, pending, n = self._dispatch(batch, seq, model=model)
+            except Exception as e:  # per-request failure: stream survives
+                self._count("errors")
+                self._emit(ServeResult(seq, "error", error=e, tenant=tenant))
+                continue
+            entry = (((seq, deadline, 0, n, tenant),), out, pending, n)
+            if not window.offer(entry):
+                # tpulint: disable=untimed-wait -- dispatch-worker-local window: offer() just returned False, so the window is non-empty and get() cannot block
+                self._retire(window.get())
+                window.offer(entry)
+
+    # -- continuous batching: the forming buffer -----------------------------
+    def _run_forming(self, window: flow.BoundedChannel) -> None:
+        """Admit requests into per-tenant FORMING batches mid-flight. A
+        batch flushes on bucket-full (`form_rows`), forming-budget expiry
+        (continuous mode), an incompatible next request (per-tenant FIFO
+        is preserved: the old batch always dispatches first), or close."""
+        forming: Dict[Optional[str], _Forming] = {}
+        while True:
+            timeout = None
+            if forming:
+                soonest = min(g.flush_at for g in forming.values())
+                if soonest != float("inf"):
+                    timeout = max(0.0, soonest - time.monotonic())
+            if timeout is None and len(window):
+                # no flush pending but batches sit in flight: poll the
+                # queue and, when it is empty, take the blocking readback
+                # NOW — a finished result must not wait for the NEXT
+                # arrival (or close) to retire. Under load the poll finds
+                # a queued request and the double buffer stays pipelined.
+                timeout = 0.0
+            try:
+                req = self._requests.get(timeout=timeout)
+            except TimeoutError:  # a forming budget expired: flush what's due
+                self._flush_due(forming, window)
+                if timeout == 0.0 and len(window):
+                    # tpulint: disable=untimed-wait -- dispatch-worker-local window: guarded by len(window) > 0, get() cannot block
+                    self._retire(window.get())
+                continue
+            except flow.ChannelClosed:
+                break
+            self._admit_forming(req, forming, window)
+            self._flush_due(forming, window)
+        for tenant in list(forming):  # close(): partial batches still dispatch
+            self._flush_group(forming.pop(tenant), window)
+
+    def _form_flush_at(self, deadline: Optional[float]) -> float:
+        """A request's forming deadline: flush when its deadline margin
+        hits the forming budget (it must still dispatch + compute inside
+        the margin), and never hold a request in FORMING longer than the
+        budget itself. Both legs are measured from admission into
+        forming, not from submit: under a backlog the queue wait alone
+        exceeds the budget, and an already-blown margin cannot be saved
+        by flushing a tiny batch — it would only shrink every batch to
+        ~1 request and collapse saturated goodput, which is exactly the
+        regime where full buckets matter most. Fixed batching never
+        flushes on time — only on a full bucket."""
+        if self.batching == "fixed":
+            return float("inf")
+        budget = self.form_budget_ms / 1000.0
+        now = time.monotonic()
+        flush_at = now + budget
+        if deadline is not None and deadline - budget > now:
+            flush_at = min(flush_at, deadline - budget)
+        return flush_at
+
+    @staticmethod
+    def _batch_sig(batch: Table) -> Optional[tuple]:
+        """Coalescing signature: two batches may share a forming batch iff
+        their column names, kinds, dtypes and trailing shapes all match
+        (row-wise kernels make the concatenation semantically the union
+        of the requests). None = host-concat is unsafe (device-resident
+        or object columns): the request dispatches alone."""
+        sig = []
+        for name in batch.column_names:
+            col = batch.column(name)
+            if isinstance(col, SparseBatch):
+                if not isinstance(col.indices, np.ndarray):
+                    return None
+                sig.append(
+                    ("sparse", name, col.size, col.indices.shape[1:], str(col.values.dtype))
+                )
+            elif isinstance(col, np.ndarray) and col.dtype != object:
+                sig.append(("np", name, col.shape[1:], str(col.dtype)))
             else:
-                hist.record("serving.deadlineMarginMs", margin_ms)
-        self._emit(ServeResult(seq, status, table=table))
+                return None
+        return tuple(sig)
+
+    @staticmethod
+    def _concat_batches(batches: List[Table]) -> Table:
+        """Host-side concatenation of signature-compatible batches — the
+        forming batch the fused plan sees as ONE bucket-padded dispatch."""
+        cols: Dict[str, Any] = {}
+        for name in batches[0].column_names:
+            vals = [b.column(name) for b in batches]
+            first = vals[0]
+            if isinstance(first, SparseBatch):
+                cols[name] = SparseBatch(
+                    first.size,
+                    np.concatenate([v.indices for v in vals], axis=0),
+                    np.concatenate([v.values for v in vals], axis=0),
+                )
+            else:
+                cols[name] = np.concatenate(vals, axis=0)
+        return Table(cols)
+
+    def _admit_forming(
+        self,
+        req: tuple,
+        forming: Dict[Optional[str], _Forming],
+        window: flow.BoundedChannel,
+    ) -> None:
+        seq, tenant, batch, deadline, submitted = req
+        now = time.monotonic()
+        hist.record("serving.queueWaitMs", (now - submitted) * 1000.0)
+        if deadline is not None and now > deadline:
+            self._quota_release(tenant)
+            metrics.inc_counter("serving.deadlineMiss")
+            metrics.inc_counter("serving.deadlineMiss.expired")
+            self._count("expired")
+            self._emit(ServeResult(seq, "expired", tenant=tenant))
+            return
+        sig = self._batch_sig(batch)
+        group = forming.get(tenant)
+        n = batch.num_rows
+        if group is not None and (
+            sig is None or group.sig != sig or group.rows + n > self.form_rows
+        ):
+            # incompatible or over-target: the older batch flushes FIRST,
+            # preserving per-tenant FIFO
+            self._flush_group(forming.pop(tenant), window)
+            group = None
+        if sig is None:  # non-coalescable: dispatch alone, right now
+            solo = _Forming(tenant, None)
+            solo.add(seq, batch, deadline, flush_at=0.0)
+            self._flush_group(solo, window)
+            return
+        if group is None:
+            group = forming[tenant] = _Forming(tenant, sig)
+        group.add(seq, batch, deadline, self._form_flush_at(deadline))
+        if group.rows >= self.form_rows:  # bucket full: go now
+            self._flush_group(forming.pop(tenant), window)
+
+    def _flush_due(
+        self, forming: Dict[Optional[str], _Forming], window: flow.BoundedChannel
+    ) -> None:
+        now = time.monotonic()
+        for tenant in [t for t, g in forming.items() if g.flush_at <= now]:
+            self._flush_group(forming.pop(tenant), window)
+
+    def _flush_group(self, group: _Forming, window: flow.BoundedChannel) -> None:
+        """Dispatch one forming batch: concat members, one fused dispatch,
+        one window entry carrying each member's row span so `_retire`
+        hands every request ITS rows back."""
+        now = time.monotonic()
+        live: List[Tuple[int, Table, Optional[float]]] = []
+        for seq, batch, deadline, admitted in group.reqs:
+            self._quota_release(group.tenant)
+            if deadline is not None and now > deadline:  # expired while forming
+                metrics.inc_counter("serving.deadlineMiss")
+                metrics.inc_counter("serving.deadlineMiss.expired")
+                self._count("expired")
+                self._emit(ServeResult(seq, "expired", tenant=group.tenant))
+                continue
+            hist.record("serving.formWaitMs", (now - admitted) * 1000.0)
+            live.append((seq, batch, deadline))
+        if not live:
+            return
+        merged = live[0][1] if len(live) == 1 else self._concat_batches([b for _, b, _ in live])
+        parts: List[Tuple[int, Optional[float], int, int, Optional[str]]] = []
+        offset = 0
+        for seq, batch, deadline in live:
+            parts.append((seq, deadline, offset, offset + batch.num_rows, group.tenant))
+            offset += batch.num_rows
+        try:
+            model = self._model_for(group.tenant)
+            out, pending, n = self._dispatch(merged, live[0][0], model=model)
+        except Exception as e:  # whole forming batch fails per-request
+            for seq, _, _ in live:
+                self._count("errors")
+                self._emit(ServeResult(seq, "error", error=e, tenant=group.tenant))
+            return
+        if len(live) > 1:
+            metrics.inc_counter("serving.coalesced", len(live))
+        entry = (tuple(parts), out, pending, n)
+        if not window.offer(entry):
+            # tpulint: disable=untimed-wait -- dispatch-worker-local window: offer() just returned False, so the window is non-empty and get() cannot block
+            self._retire(window.get())
+            window.offer(entry)
+
+    @staticmethod
+    def _slice_span(col, start: int, stop: int):
+        if isinstance(col, SparseBatch):
+            return SparseBatch(col.size, col.indices[start:stop], col.values[start:stop])
+        return col[start:stop]
+
+    @staticmethod
+    def _to_host(col):
+        if isinstance(col, SparseBatch):
+            return SparseBatch(col.size, np.asarray(col.indices), np.asarray(col.values))
+        return col if isinstance(col, np.ndarray) else np.asarray(col)
+
+    def _retire(self, entry) -> None:
+        """Retire one window entry: the single guard readback, then each
+        member request gets its row span, deadline verdict, and result.
+
+        Pad-undo and per-part span slicing happen on HOST: an eager
+        device slice compiles one XLA program per distinct (shape, span)
+        pair, and continuous forming produces an open-ended set of those
+        — steady-state paging would keep compiling, breaking the
+        zero-recompile contract the servingSlo bench pins. Push results
+        are terminal per-request responses, so the one materialization
+        here replaces the consumer's own later pull; an unpadded solo
+        batch still retires device-resident, untouched."""
+        parts, out, pending, n = entry
+        padded = out.num_rows
+        try:
+            table = self._finish(out, pending, padded)
+        except Exception as e:  # deferred guard error: per-request, in order
+            for seq, _deadline, _start, _stop, tenant in parts:
+                self._count("errors")
+                self._emit(ServeResult(seq, "error", error=e, tenant=tenant))
+            return
+        sliced = len(parts) > 1 or n != padded
+        if sliced:
+            table = Table(
+                {name: self._to_host(table.column(name)) for name in table.column_names}
+            )
+        now = time.monotonic()
+        for seq, deadline, start, stop, tenant in parts:
+            if not sliced:
+                sub = table
+            else:
+                sub = Table(
+                    {
+                        name: self._slice_span(table.column(name), start, stop)
+                        for name in table.column_names
+                    }
+                )
+            status = "ok"
+            if deadline is not None:
+                margin_ms = (deadline - now) * 1000.0
+                if margin_ms < 0:
+                    # cause-attributed miss: finished LATE after dispatch
+                    # (the compute was paid — contrast deadlineMiss.expired)
+                    metrics.inc_counter("serving.deadlineMiss")
+                    metrics.inc_counter("serving.deadlineMiss.late")
+                    hist.record("serving.lateByMs", -margin_ms)
+                    self._count("late")
+                    status = "late"
+                else:
+                    hist.record("serving.deadlineMarginMs", margin_ms)
+            self._emit(ServeResult(seq, status, table=sub, tenant=tenant))
 
     def _emit(self, result: ServeResult) -> None:
         self._count("completed")

@@ -78,6 +78,11 @@ _LOWER_BETTER = (
     # regresses in the same direction as the watermarks above
     "pershardbytes",
     "wirebytes",
+    # serving SLO (docs/serving.md): paging churn and recompiles on the
+    # steady-state serve path are regressions — servingSlo additionally
+    # pins recompileCount at 0.0 via an explicit CI --rule
+    "pageincount",
+    "recompilecount",
 )
 _HIGHER_BETTER = (
     "throughput",
@@ -88,6 +93,10 @@ _HIGHER_BETTER = (
     "hbmutilization",
     "value",
     "parity",
+    # open-loop serving rates (docs/serving.md): delivered-inside-deadline
+    # QPS and the saturation knee move up when serving improves
+    "goodputqps",
+    "saturationqps",
 )
 #: Lower-better but too noisy to gate by default (first-run XLA compile).
 _DEFAULT_INFORMATIONAL = ("coldtimems",)

@@ -91,6 +91,7 @@ class TestRunner:
             "hostSyncCount", "dispatchDepth", "fusedSegments", "collectiveBreakdown",
             "wholeFitCount", "wholeFitFallbacks",
             "fleetSize", "modelsPerSecond",
+            "offeredQps", "goodputQps", "saturationQps", "pageInCount",
             "hostDispatchMs", "dispatchGapMs", "gapCount", "dispatchAttribution",
             "h2dBytes", "h2dCount", "deviceCacheHits", "deviceCacheMisses",
             "checkpointCount", "checkpointBytes",
@@ -104,6 +105,12 @@ class TestRunner:
         # fleet fields stay zero for a solo (non-fleet) fit
         assert result["fleetSize"] == 0
         assert result["modelsPerSecond"] == 0.0
+        # serving fields stay zero for a non-serving entry (no load
+        # generator set the serving.* gauges, no model store paged)
+        assert result["offeredQps"] == 0.0
+        assert result["goodputQps"] == 0.0
+        assert result["saturationQps"] == 0.0
+        assert result["pageInCount"] == 0
         assert result["peakHbmBytes"] > 0
         assert 0 <= result["residentModelBytes"] <= result["peakHbmBytes"]
         assert result["hostSyncCount"] >= 1  # the packed fit readback

@@ -407,8 +407,9 @@ def test_server_health_stage_latency_percentiles(_clean_hist):
         p = h.stageLatencyMs[stage]
         assert p["count"] >= 8, stage
         assert 0.0 <= p["p50"] <= p["p99"] <= p["p999"], stage
-    # no deadline was set, so no margin histogram
-    assert "deadlineMargin" not in h.stageLatencyMs
+    # no deadline was set: the stage is reported, but with no
+    # observations its percentile summary is None (never fabricated)
+    assert h.stageLatencyMs["deadlineMargin"] is None
     # with a generous deadline the margin distribution appears too
     server2 = MicroBatchServer(pm, in_flight=2, admission=16)
     server2.submit(
@@ -469,7 +470,9 @@ def test_deadline_miss_cause_attribution(_clean_hist):
         Table({"features": RNG.randn(8, 4).astype(np.float32)})
     )
     out, pending = pm.transform_deferred(staged)
-    late_server._retire((0, _time.monotonic() - 1.0, out, pending, n))
+    late_server._retire(
+        (((0, _time.monotonic() - 1.0, 0, n, None),), out, pending, n)
+    )
     result = late_server._out.get()
     assert result.status == "late"
     assert metrics.get_counter("serving.deadlineMiss.late", 0) == base_late + 1
@@ -477,3 +480,302 @@ def test_deadline_miss_cause_attribution(_clean_hist):
 
     # compatibility: the old counter is exactly the sum of the causes
     assert metrics.get_counter("serving.deadlineMiss", 0) == base_sum + 2
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (ISSUE 19 tentpole): mid-flight forming, budget flush
+# ---------------------------------------------------------------------------
+
+def _push_all(server, batches, tenant=None):
+    """Submit every batch, close, and collect results keyed by seq."""
+    seqs = [server.submit(b, tenant=tenant) for b in batches]
+    server.close()
+    return seqs, {r.seq: r for r in server.results()}
+
+
+def test_continuous_bit_identical_to_request_mode():
+    """ISSUE 19 acceptance: continuous batching returns bit-identical
+    per-request rows — coalescing is a scheduling decision, never a
+    numerics decision (same bucket padding, same fused plan)."""
+    pm = _scaler_pipeline()
+    sizes = [3, 5, 2, 8, 1, 4, 7, 2]
+    batches = _batches(sizes)
+    ref_server = MicroBatchServer(pm, in_flight=2, admission=16, buckets=(8, 32))
+    _, ref = _push_all(ref_server, batches)
+    cont = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        buckets=(8, 32),
+        batching="continuous",
+        form_rows=32,
+        form_budget_ms=20.0,
+    )
+    _, got = _push_all(cont, batches)
+    assert sorted(got) == sorted(ref) == list(range(len(sizes)))
+    for seq in ref:
+        assert ref[seq].status == "ok" and got[seq].status == "ok"
+        assert got[seq].table.num_rows == sizes[seq]
+        np.testing.assert_array_equal(
+            np.asarray(ref[seq].table.column("norm")),
+            np.asarray(got[seq].table.column("norm")),
+        )
+
+
+def test_continuous_bucket_full_flushes_immediately():
+    """A forming batch that reaches `form_rows` dispatches NOW — it does
+    not sit out the rest of its forming budget."""
+    import time as _time
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        buckets=(8,),
+        batching="continuous",
+        form_rows=8,
+        form_budget_ms=10_000.0,  # a budget flush would blow the timing assert
+    )
+    before = metrics.get_counter("serving.coalesced", 0)
+    t0 = _time.monotonic()
+    server.submit(Table({"features": RNG.randn(4, 4).astype(np.float32)}))
+    server.submit(Table({"features": RNG.randn(4, 4).astype(np.float32)}))
+    it = server.results()
+    results = [next(it), next(it)]
+    dt = _time.monotonic() - t0
+    server.close()
+    assert [r.status for r in results] == ["ok", "ok"]
+    assert [r.table.num_rows for r in results] == [4, 4]
+    assert dt < 5.0, "bucket-full flush must not wait for the forming budget"
+    assert metrics.get_counter("serving.coalesced", 0) >= before + 2
+
+
+def test_continuous_form_budget_flushes_partial_batch():
+    """A lone request in a huge bucket dispatches once its forming budget
+    expires — continuous batching never strands a partial batch."""
+    import time as _time
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        batching="continuous",
+        form_rows=64,
+        form_budget_ms=30.0,
+    )
+    t0 = _time.monotonic()
+    server.submit(Table({"features": RNG.randn(2, 4).astype(np.float32)}))
+    r = next(server.results())
+    dt = _time.monotonic() - t0
+    server.close()
+    assert r.status == "ok" and r.table.num_rows == 2
+    assert dt < 5.0, "the forming-budget flush must fire without more arrivals"
+
+
+def test_fixed_batching_waits_for_full_bucket():
+    """The fixed baseline only flushes on a full bucket (or close) —
+    the structural latency continuous batching removes."""
+    import time as _time
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        batching="fixed",
+        form_rows=8,
+    )
+    server.submit(Table({"features": RNG.randn(4, 4).astype(np.float32)}))
+    _time.sleep(0.25)  # many forming budgets; fixed mode must still hold it
+    assert len(server._out) == 0, "fixed batching must not flush a partial bucket"
+    server.close()  # drain flush: the partial batch still dispatches
+    (r,) = list(server.results())
+    assert r.status == "ok" and r.table.num_rows == 4
+
+
+def test_continuous_never_coalesces_across_tenants():
+    """Two tenants' signature-identical requests stay separate forming
+    batches — results carry their tenant, and `serving.coalesced` stays
+    flat (a merged dispatch would route one tenant through the other's
+    model)."""
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        batching="continuous",
+        form_rows=8,
+        form_budget_ms=60.0,
+    )
+    before = metrics.get_counter("serving.coalesced", 0)
+    server.submit(Table({"features": RNG.randn(4, 4).astype(np.float32)}), tenant="a")
+    server.submit(Table({"features": RNG.randn(4, 4).astype(np.float32)}), tenant="b")
+    server.close()
+    results = list(server.results())
+    assert sorted(r.tenant for r in results) == ["a", "b"]
+    assert all(r.status == "ok" for r in results)
+    assert metrics.get_counter("serving.coalesced", 0) == before
+
+
+def test_continuous_incompatible_signature_flushes_old_first():
+    """An arriving request whose columns don't match the forming batch
+    flushes the OLD batch first — per-tenant FIFO survives coalescing."""
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        batching="continuous",
+        form_rows=64,
+        form_budget_ms=60.0,
+    )
+    before = metrics.get_counter("serving.coalesced", 0)
+    server.submit(Table({"features": RNG.randn(3, 4).astype(np.float32)}))
+    server.submit(Table({"features": RNG.randn(3, 4).astype(np.float64)}))  # new sig
+    server.close()
+    results = list(server.results())
+    assert [r.seq for r in results] == [0, 1], "old forming batch must retire first"
+    assert all(r.status == "ok" for r in results)
+    assert [r.table.num_rows for r in results] == [3, 3]
+    assert metrics.get_counter("serving.coalesced", 0) == before
+
+
+def test_continuous_expired_while_forming_is_shed():
+    """A request whose deadline passes inside the forming buffer is shed
+    as expired at flush time — it never pays dispatch."""
+    import time as _time
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=2,
+        admission=16,
+        batching="fixed",  # never budget-flushes: the deadline passes forming
+        form_rows=64,
+    )
+    server.submit(
+        Table({"features": RNG.randn(2, 4).astype(np.float32)}), deadline_ms=30.0
+    )
+    _time.sleep(0.08)
+    server.close()
+    (r,) = list(server.results())
+    assert r.status == "expired"
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant admission: per-tenant quota gates + fairness under flood
+# ---------------------------------------------------------------------------
+
+def test_tenant_quota_rejects_are_typed_and_attributed():
+    from flink_ml_tpu.serving import ServerOverloaded
+
+    pm = _scaler_pipeline()
+    server = MicroBatchServer(
+        pm,
+        in_flight=1,
+        admission=32,
+        batching="continuous",
+        form_rows=4,
+        tenant_quotas={"A": 2},
+    )
+    before = metrics.get_counter("serving.rejected.tenant.A", 0)
+    accepted, rejected = 0, 0
+    for _ in range(12):
+        try:
+            server.submit(
+                Table({"features": RNG.randn(4, 4).astype(np.float32)}), tenant="A"
+            )
+            accepted += 1
+        except ServerOverloaded as e:
+            rejected += 1
+            assert e.channel == "serving.tenant.A"
+            assert e.capacity == 2
+    assert rejected > 0, "an unpaced 12-burst must overflow quota=2"
+    server.close()
+    results = list(server.results())
+    assert len(results) == accepted
+    assert all(r.tenant == "A" for r in results)
+    assert metrics.get_counter("serving.rejected.tenant.A", 0) == before + rejected
+    h = server.health()
+    assert h.tenantAdmission["A"]["rejected"] == rejected
+    assert h.tenantAdmission["A"]["capacity"] == 2
+
+
+def test_tenant_fairness_soak():
+    """ISSUE 19 satellite: tenant A floods past its quota; its overflow
+    fast-fails with the typed per-tenant reject while tenant B's
+    closed-loop latency stays within tolerance of B running alone."""
+    import time as _time
+
+    from flink_ml_tpu.serving import ServerOverloaded
+
+    pm = _scaler_pipeline()
+
+    def b_batch():
+        return Table({"features": RNG.randn(4, 4).astype(np.float32)})
+
+    def closed_loop_b(server, rounds, flood_a=None):
+        """Submit one B request at a time, waiting for ITS result; returns
+        per-request client latencies (ms)."""
+        it = server.results()
+        lat = []
+        for _ in range(rounds):
+            if flood_a is not None:
+                flood_a()
+            t0 = _time.monotonic()
+            seq = server.submit(b_batch(), tenant="B")
+            while True:
+                r = next(it)
+                if r.tenant == "B" and r.seq == seq:
+                    break
+            assert r.status == "ok"
+            lat.append((_time.monotonic() - t0) * 1000.0)
+        return lat
+
+    def make_server():
+        return MicroBatchServer(
+            pm,
+            in_flight=2,
+            admission=32,
+            buckets=(8,),
+            batching="continuous",
+            form_rows=8,
+            form_budget_ms=2.0,
+            tenant_quotas={"A": 4, "B": 8},
+        )
+
+    # solo baseline: B alone (first round also absorbs any compile)
+    solo = make_server()
+    solo_lat = closed_loop_b(solo, 20)
+    solo.close()
+    list(solo.results())
+    solo_p99 = float(np.percentile(solo_lat[1:], 99))
+
+    # soak: A floods past quota=4 before every B submit
+    soak = make_server()
+    a_rejects = [0]
+
+    def flood_a():
+        for _ in range(8):
+            try:
+                soak.submit(b_batch(), tenant="A")
+            except ServerOverloaded as e:
+                assert e.channel == "serving.tenant.A"
+                a_rejects[0] += 1
+
+    soak_lat = closed_loop_b(soak, 20, flood_a=flood_a)
+    soak.close()
+    list(soak.results())
+    soak_p99 = float(np.percentile(soak_lat[1:], 99))
+
+    assert a_rejects[0] > 0, "the flood must overflow tenant A's quota"
+    h = soak.health()
+    assert h.tenantAdmission["A"]["rejected"] == a_rejects[0]
+    # fairness: B's p99 under flood stays within a generous envelope of
+    # its solo p99 (A's overflow was shed at admission, not queued ahead)
+    assert soak_p99 <= 5.0 * solo_p99 + 100.0, (
+        f"tenant B p99 {soak_p99:.1f}ms vs solo {solo_p99:.1f}ms — "
+        "a quota'd flood must not starve the well-behaved tenant"
+    )

@@ -2391,6 +2391,61 @@ class TestUnledgeredResidency:
         assert report.findings == []
         assert len(report.suppressed) == 1
 
+    def test_true_positive_raw_device_put_in_paging_helper(self, tmp_path):
+        """A model-store-style paging helper that uploads with a raw
+        `jax.device_put` bypasses the ledger: the resident model bytes
+        never land in `hbm.live.model` (ISSUE 19 satellite)."""
+        report = _run(tmp_path, {
+            "data/badstore.py": """
+                import jax
+
+                class PagingStore:
+                    def page_in_raw(self, key, host_arrays):
+                        self._resident = jax.device_put(host_arrays)
+                        return self._resident
+            """,
+            **LAZYJIT_STUB,
+            "data/__init__.py": "",
+        }, ["unledgered-residency"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data == ("jax.device_put", "self._resident")
+
+    def test_true_negative_model_store_page_in_funnel(self, tmp_path):
+        """`ModelStore.page_in` is a sanctioned funnel: every byte it
+        makes resident stages through `device_constants()` ->
+        `stage_to_device(category="model")`, so bindings fed by it are
+        ledgered by construction."""
+        from flink_ml_tpu.analysis.rules.memledger import FUNNEL_CALLS
+
+        assert "page_in" in FUNNEL_CALLS  # the ISSUE 19 sanction itself
+        report = _run(tmp_path, {
+            "data/goodstore.py": """
+                import jax
+
+                class Server:
+                    def __init__(self, store):
+                        self._store = store
+
+                    def pin_tenant(self, key, fallback):
+                        # resident + accounted: page_in rides the funnel
+                        self._hot_entry = self._store.page_in(key)
+                        return self._hot_entry
+
+                    def pin_or_stage(self, key, fallback):
+                        # funnel presence exempts the whole binding even
+                        # with a raw constructor in the expression
+                        self._entry = (
+                            self._store.page_in(key)
+                            if key in self._store
+                            else jax.device_put(fallback)
+                        )
+                        return self._entry
+            """,
+            **LAZYJIT_STUB,
+            "data/__init__.py": "",
+        }, ["unledgered-residency"])
+        assert report.findings == []
+
 
 # ---------------------------------------------------------------------------
 # vmap transparency: the fleet kernels wrap resident bodies in jax.vmap
