@@ -2114,6 +2114,128 @@ def bench_multichip_collectives(device_counts=(2, 8), in_budget=lambda: True):
     return {"substrate": "virtual_cpu_devices", "runs": runs}
 
 
+def bench_aot_cold_start(in_budget=lambda: True):
+    """The AOT-program-bank cold-start entry (ISSUE 20 / ROADMAP item 5,
+    docs/performance.md §12): fresh-process first-serve walls with the
+    bank on vs off, plus the no-compile SLA asserted both cross-process
+    and in-process.
+
+    Three subprocesses run scripts/coldstart_smoke.py against one bank
+    directory: ``populate`` (warmup AOT-compiles + back-fills the bank),
+    ``serve`` (fresh process warm-loads the bank and serves its first
+    request — the script itself exits 1 unless that dispatch performed
+    zero kernel traces AND zero XLA backend compiles), and ``baseline``
+    (bank off: the same first serve pays trace + compile). Asserted
+    here: serveTraceCount == serveCompileCount == 0 on the banked serve,
+    and the output sha256 of the bank-loaded executable matches the
+    freshly-compiled baseline bit-for-bit. Then the same workload runs
+    IN this process — once bank-off (fresh compile) and once under
+    ``config.program_bank_mode`` (warm-load + hit) — and the two output
+    buffers must compare equal byte-for-byte with a zero trace delta on
+    the banked run."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "coldstart_smoke.py"
+    )
+    bank_dir = tempfile.mkdtemp(prefix="aot-bank.")
+
+    def run(mode):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, script, bank_dir, mode],
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        if proc.returncode != 0:
+            tail = "; ".join(proc.stderr.strip().splitlines()[-3:])
+            raise RuntimeError(f"coldstart_smoke {mode}: exit {proc.returncode}: {tail}")
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out["processWallMs"] = wall_ms
+        return out
+
+    try:
+        populate = run("populate")
+        if not in_budget():
+            return {"skipped": "budget", "populate": populate}
+        serve = run("serve")
+        baseline = run("baseline")
+
+        assert serve["serveTraceCount"] == 0.0 and serve["serveCompileCount"] == 0.0, (
+            f"no-compile SLA violated on fresh-process serve: {serve}"
+        )
+        assert serve["bankHits"] >= 1.0 and serve["bankLoads"] >= 1.0, (
+            f"banked serve never hit the bank: {serve}"
+        )
+        assert serve["outSha"] == baseline["outSha"], (
+            "bank-loaded executable output diverged from freshly-compiled "
+            f"baseline: {serve['outSha']} != {baseline['outSha']}"
+        )
+
+        # in-process bit-identity + zero-trace check: same workload, fresh
+        # compile vs warm-loaded bank hit, byte-compared
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("coldstart_smoke", script)
+        smoke = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(smoke)
+
+        from flink_ml_tpu import config
+        from flink_ml_tpu.serving import MicroBatchServer
+        from flink_ml_tpu.utils import metrics
+
+        def serve_once():
+            model, example = smoke.build_workload()
+            server = MicroBatchServer(model, buckets=smoke.BUCKETS)
+            out = list(server.serve(iter([example])))[0]
+            return np.ascontiguousarray(
+                np.asarray(out.column("norm"), dtype=np.float32)
+            )
+
+        fresh = serve_once()
+        with config.program_bank_mode(bank_dir):
+            before = metrics.snapshot()
+            banked = serve_once()
+            delta = metrics.snapshot_delta(before, metrics.snapshot())["counters"]
+        assert delta.get("jit.traces", 0) == 0, (
+            f"in-process banked serve traced: {delta}"
+        )
+        assert fresh.tobytes() == banked.tobytes(), (
+            "in-process bank-loaded output is not bit-identical to the "
+            "freshly-compiled one"
+        )
+
+        log(
+            f"aotColdStart: cold start {serve['coldStartMs']:.0f}ms banked vs "
+            f"{baseline['coldStartMs']:.0f}ms baseline; first serve "
+            f"{serve['firstServeMs']:.1f}ms vs {baseline['firstServeMs']:.1f}ms; "
+            f"bank load {serve['bankLoadMs']:.1f}ms ({serve['bankLoads']:.0f} "
+            "programs); zero traces/compiles + bit-identity verified"
+        )
+        return {
+            "coldStartMs": serve["coldStartMs"],
+            "baselineColdStartMs": baseline["coldStartMs"],
+            "firstServeMs": serve["firstServeMs"],
+            "baselineFirstServeMs": baseline["firstServeMs"],
+            "populateMs": populate["warmupMs"],
+            "bankLoadMs": serve["bankLoadMs"],
+            "bankLoads": serve["bankLoads"],
+            "bankHits": serve["bankHits"],
+            "bankMisses": serve["bankMisses"],
+            "serveTraceCount": serve["serveTraceCount"],
+            "serveCompileCount": serve["serveCompileCount"],
+            "baselineServeTraceCount": baseline["serveTraceCount"],
+            "baselineServeCompileCount": baseline["serveCompileCount"],
+            "bitIdentical": True,
+        }
+    finally:
+        shutil.rmtree(bank_dir, ignore_errors=True)
+
+
 def main(argv):
     _enable_compilation_cache()
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -2143,6 +2265,7 @@ def main(argv):
         "overloadSoak": None,
         "hotSwapSoak": None,
         "servingSlo": None,
+        "aotColdStart": None,
         "multichipCollectives": None,
     }
     value, vs_baseline, vs_baseline_source = None, None, None
@@ -2278,6 +2401,12 @@ def main(argv):
                 details["servingSlo"] = bench_serving_slo(in_budget=in_budget)
             except Exception as e:
                 log(f"servingSlo stage failed: {e!r}")
+
+        if in_budget():
+            try:
+                details["aotColdStart"] = bench_aot_cold_start(in_budget=in_budget)
+            except Exception as e:
+                log(f"aotColdStart stage failed: {e!r}")
 
         if in_budget():
             try:

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     precision,
     residentprogram,
     retrace,
+    servepath,
     shardingtags,
     snapshotcommit,
     specconsistency,

@@ -648,6 +648,56 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
 if os.environ.get("FLINK_ML_TPU_COMPILATION_CACHE_DIR"):
     enable_compilation_cache(os.environ["FLINK_ML_TPU_COMPILATION_CACHE_DIR"])
 
+
+# --- AOT program bank (compilebank.py) ----------------------------------------
+# The persistent XLA cache above only memoizes the *backend compile* after
+# a trace has happened; the program bank goes further: serialized
+# executables keyed by (kernel id x abstract shapes/dtypes x static args x
+# mesh topology x jax version) are warm-loaded at process start, so a
+# bank hit bypasses trace AND compile entirely (docs/performance.md §12).
+# None = bank off — every kernel behaves exactly as before.
+program_bank_dir: Optional[str] = None
+# keyed_jit factory caches are LRU-bounded at this many entries; an
+# eviction ticks jit.kernelCacheEvict and the re-touched key re-traces
+# with identical results (pinned by tests/test_compilebank.py).
+kernel_cache_size: int = 256
+
+
+@contextmanager
+def program_bank_mode(path: Optional[str]):
+    """Scoped override of `program_bank_dir` (None = bank off). The
+    active ProgramBank singleton is reset on entry and exit so the scope
+    sees a bank freshly warm-loaded from `path`."""
+    global program_bank_dir
+    prev = program_bank_dir
+    program_bank_dir = path
+    from . import compilebank
+
+    compilebank.reset_active_bank()
+    try:
+        yield
+    finally:
+        program_bank_dir = prev
+        compilebank.reset_active_bank()
+
+
+@contextmanager
+def kernel_cache_limit(size: int):
+    """Scoped override of `kernel_cache_size` (>= 1)."""
+    global kernel_cache_size
+    prev = kernel_cache_size
+    kernel_cache_size = max(1, int(size))
+    try:
+        yield
+    finally:
+        kernel_cache_size = prev
+
+
+if os.environ.get("FLINK_ML_TPU_PROGRAM_BANK_DIR"):
+    program_bank_dir = os.environ["FLINK_ML_TPU_PROGRAM_BANK_DIR"]
+if os.environ.get("FLINK_ML_TPU_KERNEL_CACHE_SIZE"):
+    kernel_cache_size = max(1, int(os.environ["FLINK_ML_TPU_KERNEL_CACHE_SIZE"]))
+
 # Spillable data-cache defaults for training on StreamTable inputs (the
 # analogue of `iteration.data-cache.path` + managed-memory weights in the
 # reference). Batches beyond the in-memory budget spill to disk segments.

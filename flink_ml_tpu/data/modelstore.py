@@ -302,6 +302,18 @@ class ModelStore:
             return None
         return flow.spawn(_warm, name=f"{self.name}.prefetch")
 
+    def warmup_programs(
+        self, server, example, buckets=None
+    ) -> "Dict[str, float]":
+        """Drive every (registered tenant x bucket) serving program once
+        through `server` (a MicroBatchServer) ahead of traffic: models
+        page in through the normal `page_in` funnel and each program
+        compiles — or, with an AOT program bank active
+        (`config.program_bank_dir`), warm-loads without a trace or
+        compile. The store side of the no-compile serving SLA
+        (docs/performance.md §12)."""
+        return server.warmup(example, tenants=self.keys(), buckets=buckets)
+
     # -- lifecycle integration ----------------------------------------------
     def promote(self, key: str, arrays: tuple, version: Optional[int] = None):
         """Promote a candidate through `key`'s lifecycle ring (gate +

@@ -215,6 +215,47 @@ def run_summaries(trace: Trace) -> List[Dict]:
     )
 
 
+def compile_cost(trace: Trace) -> List[Dict]:
+    """Per-kernel compile cost and the AOT-program-bank hit/load split
+    (docs/performance.md §12).
+
+    `bank.compile` spans carry kernel attribution (the bank's AOT
+    trace+lower+compile, backfilling a miss); `jit.compile` spans with no
+    `bank.compile` ancestor are backend compiles the bank never saw
+    (raw-jit paths, op-by-op host compiles) and aggregate into one
+    unattributed row. `bank.hit` / `bank.load` events count warm
+    executions and warm-loaded entries per kernel."""
+    rows: Dict[str, Dict] = {}
+
+    def row(kernel: str) -> Dict:
+        return rows.setdefault(
+            kernel,
+            {"kernel": kernel, "compiles": 0, "compileMs": 0.0,
+             "bankHits": 0, "bankLoads": 0},
+        )
+
+    for r in trace.records:
+        name = r.get("name")
+        kernel = (r.get("attrs") or {}).get("kernel") or "?"
+        if name == "bank.compile":
+            entry = row(kernel)
+            entry["compiles"] += 1
+            entry["compileMs"] += float(r.get("durUs", 0.0)) / 1000.0
+        elif name == "bank.hit":
+            row(kernel)["bankHits"] += 1
+        elif name == "bank.load":
+            row(kernel)["bankLoads"] += 1
+        elif name == "jit.compile" and not any(
+            a.get("name") == "bank.compile" for a in trace.ancestors(r)
+        ):
+            entry = row("(unattributed XLA compile)")
+            entry["compiles"] += 1
+            entry["compileMs"] += float(r.get("durUs", 0.0)) / 1000.0
+    return sorted(
+        rows.values(), key=lambda e: (-e["compileMs"], e["kernel"])
+    )
+
+
 def _stage_label(record: Dict) -> str:
     attrs = record.get("attrs") or {}
     stage = attrs.get("stage", "?")
@@ -305,6 +346,41 @@ def render_report(records: List[Dict], max_epochs: int = 20) -> str:
         sections.append(
             "== Iteration runs (on-device loops report one summary span) ==\n"
             + "\n".join(lines)
+        )
+
+    cost = compile_cost(trace)
+    if cost:
+        # full kernel ids live in the JSON payload (scripts/obs_report.py
+        # --format json); the text table elides the middle to stay scannable
+        def _elide(kernel: str, width: int = 72) -> str:
+            if len(kernel) <= width:
+                return kernel
+            half = (width - 3) // 2
+            return kernel[:half] + "..." + kernel[-half:]
+
+        rows = [
+            [
+                _elide(e["kernel"]),
+                str(e["compiles"]),
+                f"{e['compileMs']:.1f}",
+                str(e["bankHits"]),
+                str(e["bankLoads"]),
+            ]
+            for e in cost
+        ]
+        rows.append([
+            "TOTAL",
+            str(sum(e["compiles"] for e in cost)),
+            f"{sum(e['compileMs'] for e in cost):.1f}",
+            str(sum(e["bankHits"] for e in cost)),
+            str(sum(e["bankLoads"] for e in cost)),
+        ])
+        sections.append(
+            "== Compile cost (AOT program bank, docs/performance.md §12) ==\n"
+            + _table(
+                ["kernel", "compiles", "compileMs", "bankHits", "bankLoads"],
+                rows,
+            )
         )
 
     # collective traffic across the whole trace

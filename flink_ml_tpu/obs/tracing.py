@@ -351,8 +351,16 @@ def install_jax_hooks() -> bool:
     def _on_duration(event: str, duration: float, **kwargs) -> None:
         if event != _COMPILE_EVENT:
             return
+        # REAL XLA backend compiles only: program-bank executable loads
+        # (compilebank.py) never fire this event — they tick the distinct
+        # jit.bankLoads counter instead, which is what keeps the
+        # zero-tolerance servingSlo.recompileCount / aotColdStart CI pins
+        # honest when the bank satisfies a program without a compile.
         metrics.inc_counter("jit.compiles")
         metrics.record_time("jit.compile", duration)
+        from . import hist
+
+        hist.record("jit.compileMs", duration * 1000.0)
         if _enabled:
             emit_completed(
                 "jit.compile",

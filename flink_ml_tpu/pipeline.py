@@ -81,9 +81,12 @@ class FusedSegment:
         self.indices = [i for i, _ in indexed_stages]
         self.stages: List[AlgoOperator] = [s for _, s in indexed_stages]
         self._jit = None
+        self._traced = None  # jit.traces-counting wrapper around _run
         # guard messages in program-output order; captured at trace time
         # (fixed for a given stage list — every compiled signature of this
-        # segment registers the same guards)
+        # segment registers the same guards). A program-bank hit skips the
+        # trace, so the messages are restored from the bank entry's extras
+        # instead (compilebank.py — same list, persisted at backfill time).
         self._guard_messages: List[str] = []
 
     @property
@@ -143,14 +146,38 @@ class FusedSegment:
         )
         return cols, guard_vec
 
+    def bank_kernel_id(self) -> Optional[str]:
+        """Process-restart-stable program-bank identity for this segment:
+        stage classes + their param values (model arrays are runtime
+        operands whose shapes live in the call signature, not here). None
+        when a param value has no stable token — that segment skips the
+        bank and keeps the classic jit path."""
+        from . import compilebank
+
+        parts = []
+        for stage in self.stages:
+            tokens = []
+            for param, value in sorted(
+                stage.get_param_map().items(), key=lambda kv: kv[0].name
+            ):
+                token = compilebank.static_token(value)
+                if token is None:
+                    return None
+                tokens.append(f"{param.name}={token}")
+            cls = type(stage)
+            parts.append(f"{cls.__module__}.{cls.__qualname__}({','.join(tokens)})")
+        return "pipeline.FusedSegment[" + ";".join(parts) + "]"
+
+    def _traced_run(self):
+        if self._traced is None:
+            from .utils.lazyjit import _traced
+
+            self._traced = _traced(self._run)
+        return self._traced
+
     def execute(
         self, table: Table, feed: Dict[str, Any], pending: List[Tuple[Tuple[str, ...], Any]]
     ) -> Table:
-        if self._jit is None:
-            import jax
-
-            # tpulint: disable=retrace-hazard -- one compile per fused segment; plans are cached keyed on stage ids + params + model-array identities
-            self._jit = jax.jit(self._run)
         # model constants are RUNTIME OPERANDS of the jitted program, not
         # baked trace constants: fetched per dispatch (memoized uploads —
         # `device_constants` re-uploads only after a publication bump), so
@@ -159,10 +186,49 @@ class FusedSegment:
         # here — the batch in flight keeps exactly the version it was
         # dispatched with, however many swaps land during its compute.
         consts_list = [stage.device_constants() for stage in self.stages]
-        out_cols, guard_vec = self._jit(consts_list, feed)
+        out = self._execute_banked(consts_list, feed)
+        if out is None:
+            if self._jit is None:
+                import jax
+
+                # tpulint: disable=retrace-hazard,serve-path-trace -- bank-off fallback: one compile per fused segment (plan cached on stage ids + params); with a bank active execute() routes through _execute_banked and never reaches this line
+                self._jit = jax.jit(self._traced_run())
+            out = self._jit(consts_list, feed)
+        out_cols, guard_vec = out
         if self._guard_messages:
             pending.append((tuple(self._guard_messages), guard_vec))
         return table.with_columns(out_cols)
+
+    def _execute_banked(self, consts_list, feed):
+        """Run through the AOT program bank when one is active: a hit
+        calls a warm-loaded executable (zero traces, zero compiles — the
+        serving no-compile SLA) and restores the trace-time guard
+        messages from the entry's extras; a miss AOT-compiles and
+        back-fills. None = bank off / segment unbankable."""
+        from . import compilebank
+
+        bank = compilebank.active_bank()
+        if bank is None:
+            return None
+        kernel_id = self.bank_kernel_id()
+        if kernel_id is None:
+            return None
+
+        def on_extras(extras):
+            if extras and extras.get("guards") is not None:
+                self._guard_messages = list(extras["guards"])
+
+        handled, result = compilebank.banked_call(
+            bank,
+            kernel_id,
+            self._traced_run(),
+            (consts_list, feed),
+            {},
+            {},
+            extras_fn=lambda: {"guards": list(self._guard_messages)},
+            on_extras=on_extras,
+        )
+        return result if handled else None
 
 
 class _FusionPlan:

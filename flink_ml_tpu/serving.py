@@ -422,6 +422,79 @@ class MicroBatchServer:
     def _count(self, key: str, n: int = 1) -> None:
         self._counts[key] = self._counts.get(key, 0) + n
 
+    # -- warmup: the no-compile serving SLA ----------------------------------
+    @staticmethod
+    def _example_rows(example: Table, rows: int) -> Table:
+        """Resize an example batch to exactly `rows` rows (slice down or
+        repeat-last-row pad up) so its staged form lands on one bucket."""
+        cols: Dict[str, Any] = {}
+        n = example.num_rows
+        for name in example.column_names:
+            col = example.column(name)
+            cols[name] = (
+                _slice_rows(col, rows) if n >= rows else _pad_rows(col, n, rows)
+            )
+        return Table(cols)
+
+    def warmup(
+        self,
+        example: Table,
+        tenants: Optional[Sequence[Optional[str]]] = None,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> Dict[str, float]:
+        """Drive every (tenant x bucket) serving program once ahead of
+        traffic, so the first real request finds its program resident.
+
+        `example` is a schema template (one real batch — column names,
+        dtypes, sparse layouts); each declared bucket gets a synthetic
+        batch of exactly that many rows dispatched through the normal
+        `_dispatch` funnel, which pages the tenant's model in through
+        the ModelStore and compiles (or bank-loads) the fused segment
+        program. With an active AOT program bank
+        (`config.program_bank_dir`, compilebank.py) the compiled
+        programs back-fill the bank, so the NEXT process's warmup is
+        pure warm-loads — zero traces, zero XLA compiles — and its
+        first request meets the no-compile SLA (`aotColdStart` bench
+        entry asserts exactly this).
+
+        Returns {"programs", "warmupMs", "bankHits", "bankMisses"} for
+        the run; a guard tripped by synthetic rows is swallowed (the
+        program is compiled either way — warmup must never take the
+        server down)."""
+        from .utils.metrics import snapshot_delta
+
+        if buckets is None:
+            buckets = self.buckets or [_next_bucket(self.form_rows, None)]
+        buckets = sorted({int(b) for b in buckets})
+        if tenants is None:
+            tenants = list(self.store.keys()) if self.store is not None else [None]
+        if self.store is not None:
+            # page every tenant's model in first: warmup compiles against
+            # resident model operands exactly as live dispatches will
+            self.store.prefetch([t for t in tenants if t is not None], wait=True)
+        t0 = time.perf_counter()
+        before = metrics.snapshot()
+        programs = 0
+        for tenant in tenants:
+            model = self._model_for(tenant)
+            for bucket in buckets:
+                synth = self._example_rows(example, bucket)
+                try:
+                    out, pending, n = self._dispatch(synth, index=-1, model=model)
+                    self._finish(out, pending, n)
+                except ValueError:
+                    pass  # a guard fired on the synthetic rows; program is live
+                programs += 1
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        metrics.record_time("serving.warmup", wall_ms / 1000.0)
+        delta = snapshot_delta(before, metrics.snapshot())["counters"]
+        return {
+            "programs": float(programs),
+            "warmupMs": wall_ms,
+            "bankHits": float(delta.get("bank.hits", 0)),
+            "bankMisses": float(delta.get("bank.misses", 0)),
+        }
+
     def _finish(self, out: Table, pending: List[Tuple[str, Any]], n: int) -> Table:
         """Retire one batch from the in-flight window: ONE packed guard
         readback (the batch's only blocking sync), then slice the padding

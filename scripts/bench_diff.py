@@ -83,6 +83,14 @@ _LOWER_BETTER = (
     # pins recompileCount at 0.0 via an explicit CI --rule
     "pageincount",
     "recompilecount",
+    # AOT program bank (docs/performance.md §12): a longer banked cold
+    # start or any bank miss on the declared program space is a
+    # regression — aotColdStart additionally pins serveTraceCount at 0.0
+    # via an explicit CI --rule (the no-compile serving SLA)
+    "coldstartms",
+    "bankmisses",
+    "servetracecount",
+    "servecompilecount",
 )
 _HIGHER_BETTER = (
     "throughput",

@@ -138,6 +138,7 @@ def main(argv):
                 {"attrs": r.get("attrs", {}), "wallUs": r.get("durUs", 0.0)}
                 for r in report.run_summaries(trace)
             ],
+            "compileCost": report.compile_cost(trace),
         }
         print(json.dumps(payload, indent=2))
     else:

@@ -70,6 +70,11 @@ def test_metric_directions():
     assert bench_diff.metric_direction("peakHbmBytes") == "lower"
     assert bench_diff.metric_direction("residentModelBytes") == "lower"
     assert bench_diff.metric_direction("kmeans.peakHbmBytes") == "lower"
+    # AOT program bank (docs/performance.md §12): a slower banked cold
+    # start or any miss on the declared program space gates by default
+    assert bench_diff.metric_direction("aotColdStart.coldStartMs") == "lower"
+    assert bench_diff.metric_direction("aotColdStart.baselineColdStartMs") == "lower"
+    assert bench_diff.metric_direction("aotColdStart.bankMisses") == "lower"
 
 
 def test_hbm_memory_regression_fails_gate():
@@ -311,3 +316,26 @@ def test_cli_latest_pair_and_usage_errors(tmp_path):
     assert _run_cli().returncode == 0  # no args -> usage text, rc 0
     assert _run_cli("only_one.json").returncode == 2
     assert _run_cli("missing_a.json", "missing_b.json").returncode == 2
+
+
+def test_aot_cold_start_regressions_fail_gate():
+    """A banked cold start that slows past threshold, or any bank miss
+    appearing on the declared program space, must REGRESS by default;
+    the CI --rule pins serveTraceCount at exactly zero (the no-compile
+    serving SLA, docs/performance.md §12)."""
+    rows = bench_diff.diff_entries(
+        {"aotColdStart": {"coldStartMs": 400.0, "bankMisses": 0.0}},
+        {"aotColdStart": {"coldStartMs": 900.0, "bankMisses": 2.0}},
+        0.15,
+        [],
+    )
+    verdicts = {r["path"]: r["verdict"] for r in rows}
+    assert verdicts["aotColdStart.coldStartMs"] == "REGRESSED"
+    assert verdicts["aotColdStart.bankMisses"] == "REGRESSED"
+    strict = bench_diff.diff_entries(
+        {"aotColdStart": {"serveTraceCount": 0.0}},
+        {"aotColdStart": {"serveTraceCount": 1.0}},
+        0.15,
+        [("aotColdStart.serveTraceCount", 0.0)],
+    )
+    assert strict[0]["verdict"] == "REGRESSED"

@@ -582,3 +582,62 @@ def test_obs_report_cli_truncated_fixture():
         capture_output=True, text=True, cwd=root,
     )
     assert bad.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# compile-cost section (AOT program bank, ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_compile_cost_attribution_and_bank_split():
+    records = [
+        # attributed AOT compile; the nested backend-compile span is the
+        # same cost and must NOT double-count into the unattributed row
+        {"name": "bank.compile", "spanId": 1, "parentId": 0, "startUs": 0.0,
+         "durUs": 5000.0, "attrs": {"kernel": "models.k1", "category": "compile"}},
+        {"name": "jit.compile", "spanId": 2, "parentId": 1, "startUs": 100.0,
+         "durUs": 4000.0, "attrs": {"category": "compile"}},
+        # a backend compile the bank never saw
+        {"name": "jit.compile", "spanId": 3, "parentId": 0, "startUs": 9000.0,
+         "durUs": 2000.0, "attrs": {"category": "compile"}},
+        # warm path: two loads, one hit
+        {"name": "bank.load", "spanId": 4, "parentId": 0, "startUs": 0.0,
+         "durUs": 0.0, "attrs": {"kernel": "models.k1"}},
+        {"name": "bank.load", "spanId": 5, "parentId": 0, "startUs": 0.0,
+         "durUs": 0.0, "attrs": {"kernel": "models.k2"}},
+        {"name": "bank.hit", "spanId": 6, "parentId": 0, "startUs": 10.0,
+         "durUs": 0.0, "attrs": {"kernel": "models.k1", "category": "cache"}},
+    ]
+    rows = {r["kernel"]: r for r in report.compile_cost(report.Trace(records))}
+    assert rows["models.k1"]["compiles"] == 1
+    assert rows["models.k1"]["compileMs"] == pytest.approx(5.0)
+    assert rows["models.k1"]["bankHits"] == 1
+    assert rows["models.k1"]["bankLoads"] == 1
+    assert rows["models.k2"] == {"kernel": "models.k2", "compiles": 0,
+                                 "compileMs": 0.0, "bankHits": 0, "bankLoads": 1}
+    unattributed = rows["(unattributed XLA compile)"]
+    assert unattributed["compiles"] == 1
+    assert unattributed["compileMs"] == pytest.approx(2.0)
+    text = report.render_report(records)
+    assert "Compile cost" in text and "models.k1" in text
+
+
+def test_compile_cost_survives_truncated_trace():
+    """Regression (sanitize contract): a ring-truncated trace that loses
+    a bank.compile end must still render the compile-cost section from
+    the surviving spans — dropped records, never a crash."""
+    records = [
+        {"name": "bank.compile", "spanId": 1, "parentId": 0, "startUs": 0.0,
+         "durUs": 3000.0, "attrs": {"kernel": "models.k1", "category": "compile"}},
+        {"name": "bank.hit", "spanId": 2, "parentId": 0, "startUs": 10.0,
+         "durUs": 0.0, "attrs": {"kernel": "models.k1"}},
+        # mid-span truncation: a begin with no end, plus schema-less junk
+        {"ph": "B", "lane": "host:t", "name": "bank.compile", "tsUs": 50.0,
+         "ref": 9},
+        {"name": "half a record"},
+        "garbage line",
+    ]
+    clean, dropped = report.sanitize_records(records)
+    assert dropped == 3
+    rows = report.compile_cost(report.Trace(clean))
+    assert [r["kernel"] for r in rows] == ["models.k1"]
+    assert "Compile cost" in report.render_report(clean)

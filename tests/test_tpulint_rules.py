@@ -2578,3 +2578,114 @@ class TestSpecConsistencyVmap:
             "models/__init__.py": "",
         }, ["spec-consistency"])
         assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# serve-path-trace
+# ---------------------------------------------------------------------------
+
+SERVING_ROOT = {
+    "serving.py": """
+        from .pipeline import PipelineModel
+
+        class MicroBatchServer:
+            def __init__(self, model):
+                self.model = model
+
+            def _dispatch(self, batch):
+                return self.model.transform(batch)
+    """,
+    "__init__.py": "",
+}
+
+
+class TestServePathTrace:
+    def test_true_positive_raw_jit_reachable_via_cha(self, tmp_path):
+        report = _run(tmp_path, {
+            **SERVING_ROOT,
+            "pipeline.py": """
+                import jax
+
+                class PipelineModel:
+                    def transform(self, batch):
+                        fn = jax.jit(lambda x: x * 2.0)
+                        return fn(batch)
+            """,
+            **LAZYJIT_STUB,
+        }, ["serve-path-trace"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.path == "flink_ml_tpu/pipeline.py"
+        assert f.data[0] == "raw-jit"
+        assert "MicroBatchServer._dispatch" in f.message
+
+    def test_true_positive_on_path_wrapper_construction(self, tmp_path):
+        report = _run(tmp_path, {
+            **SERVING_ROOT,
+            "pipeline.py": """
+                from .utils.lazyjit import lazy_jit
+
+                class PipelineModel:
+                    def transform(self, batch):
+                        fn = lazy_jit(lambda x: x * 2.0)
+                        return fn(batch)
+            """,
+            **LAZYJIT_STUB,
+        }, ["serve-path-trace"])
+        assert len(report.findings) == 1
+        assert report.findings[0].data[0] == "on-path-wrapper"
+
+    def test_true_negative_module_level_wrapper(self, tmp_path):
+        report = _run(tmp_path, {
+            **SERVING_ROOT,
+            "pipeline.py": """
+                from .utils.lazyjit import lazy_jit
+
+                def _scale(x):
+                    return x * 2.0
+
+                _kernel = lazy_jit(_scale)
+
+                class PipelineModel:
+                    def transform(self, batch):
+                        return _kernel(batch)
+            """,
+            **LAZYJIT_STUB,
+        }, ["serve-path-trace"])
+        assert report.findings == []
+
+    def test_true_negative_training_path_raw_jit_unreachable(self, tmp_path):
+        report = _run(tmp_path, {
+            **SERVING_ROOT,
+            "pipeline.py": """
+                class PipelineModel:
+                    def transform(self, batch):
+                        return batch
+            """,
+            "trainer.py": """
+                import jax
+
+                def fit(X):
+                    return jax.jit(lambda x: x.sum())(X)
+            """,
+            **LAZYJIT_STUB,
+        }, ["serve-path-trace"])
+        assert report.findings == []
+
+    def test_suppression_with_reason_is_the_census_entry(self, tmp_path):
+        report = _run(tmp_path, {
+            **SERVING_ROOT,
+            "pipeline.py": """
+                import jax
+
+                class PipelineModel:
+                    def transform(self, batch):
+                        # tpulint: disable=serve-path-trace -- bank-off fallback, one compile per plan
+                        fn = jax.jit(lambda x: x * 2.0)
+                        return fn(batch)
+            """,
+            **LAZYJIT_STUB,
+        }, ["serve-path-trace"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+        assert report.suppressed[0].rule == "serve-path-trace"
